@@ -1,0 +1,2 @@
+from .abstract_accelerator import Accelerator
+from .tpu_accelerator import TpuAccelerator, get_accelerator

@@ -1,0 +1,68 @@
+"""Accelerator abstraction.
+
+TPU-native analog of ``DeepSpeedAccelerator`` (accelerator/abstract_accelerator.py:10).
+The reference defines ~80 abstract methods over torch devices/streams/memory; in a
+JAX world most of that surface collapses: streams/events become implicit in XLA's
+async dispatch, memory stats come from device memory_stats(), and op-builder
+resolution disappears (kernels are Pallas functions, JIT-compiled by XLA).  We keep
+the subset that the runtime, tests, and tooling actually consume, with the same
+method names so a reference user can orient quickly.
+"""
+
+import abc
+
+
+class Accelerator(abc.ABC):
+    """Minimal device abstraction consumed by the engine/runtime."""
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def synchronize(self):
+        ...
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def random_seed(self, seed: int):
+        ...

@@ -1,0 +1,116 @@
+"""TPU (and CPU-simulated) accelerator implementation.
+
+Analog of accelerator/cuda_accelerator.py — but for JAX backends.  One class
+covers TPU and the CPU host-simulation used by the test harness, since JAX
+abstracts both behind the same device API.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import Accelerator
+from ..utils.logging import logger
+
+
+class TpuAccelerator(Accelerator):
+
+    def __init__(self):
+        self._name = None
+
+    # -- identity -------------------------------------------------------------
+    def _platform(self) -> str:
+        return jax.devices()[0].platform
+
+    def device_name(self, device_index=None) -> str:
+        if device_index is None:
+            return self._platform()
+        return f"{self._platform()}:{device_index}"
+
+    def device(self, device_index=None):
+        return jax.devices()[device_index or 0]
+
+    def current_device(self):
+        return jax.devices()[0]
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    def device_count(self) -> int:
+        return jax.local_device_count()
+
+    def global_device_count(self) -> int:
+        return jax.device_count()
+
+    def is_available(self) -> bool:
+        try:
+            return len(jax.devices()) > 0
+        except RuntimeError:
+            return False
+
+    # -- synchronization ------------------------------------------------------
+    def synchronize(self, device_index=None):
+        # XLA dispatch is async; block_until_ready on a trivial transfer drains it.
+        jnp.zeros(()).block_until_ready()
+
+    # -- dtype support --------------------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        # fp16 compute is supported on TPU but bf16 is the native fast path.
+        return True
+
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
+
+    # -- memory ---------------------------------------------------------------
+    def _stats(self, device_index=None) -> dict:
+        dev = jax.local_devices()[device_index or 0]
+        try:
+            return dev.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("peak_bytes_in_use", 0))
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        stats = self._stats(device_index)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    def empty_cache(self):
+        pass  # XLA owns allocation; no-op (reference empties the CUDA cache)
+
+    # -- communication --------------------------------------------------------
+    def communication_backend_name(self) -> str:
+        return "xla"
+
+    # -- rng ------------------------------------------------------------------
+    def random_seed(self, seed: int):
+        return jax.random.PRNGKey(seed)
+
+    def on_accelerator(self, array) -> bool:
+        return isinstance(array, jax.Array)
+
+
+_ACCELERATOR: Optional[TpuAccelerator] = None
+
+
+def get_accelerator() -> TpuAccelerator:
+    """Analog of real_accelerator.get_accelerator (accelerator/real_accelerator.py:51).
+    There is a single backend family (JAX), so no DS_ACCELERATOR probing."""
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = TpuAccelerator()
+    return _ACCELERATOR

@@ -1,0 +1,3 @@
+from .comm import (ReduceOp, all_gather, all_reduce, all_to_all, axis_index, barrier, broadcast, configure,
+                   get_local_rank, get_rank, get_world_size, host_all_reduce, host_broadcast, init_distributed,
+                   is_initialized, log_summary, ppermute, reduce_scatter)

@@ -1,0 +1,239 @@
+"""Distributed communication facade.
+
+TPU-native analog of ``deepspeed.comm`` (deepspeed/comm/comm.py:222-521 module-level
+ops, ``init_distributed:604``).  The reference wraps torch.distributed/NCCL; here
+collectives are XLA mesh-axis operations with two calling conventions:
+
+1. **In-graph** (inside jit / shard_map over a Mesh): ``all_reduce(x, axis="data")``
+   lowers to ``lax.psum`` and friends — XLA routes them over ICI and overlaps with
+   compute.  This is the hot path ZeRO/MoE/Ulysses use.
+2. **Host-level** (eager, outside jit): same function names operate on jax.Arrays
+   by jitting a trivial collective over the current topology — used for control
+   plane work (broadcast of initial params, barriers, scalar consensus) where the
+   reference used eager NCCL calls.
+
+Every op is profiled through the CommsLogger (analog of ``timed_op`` comm.py:101).
+"""
+
+import functools
+import time
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..parallel.mesh import MeshTopology, get_topology
+from ..utils.comms_logging import get_comms_logger
+from ..utils.logging import logger
+
+ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
+
+_INITIALIZED = False
+
+
+def init_distributed(dist_backend: str = "xla",
+                     auto_mpi_discovery: bool = True,
+                     init_method: Optional[str] = None,
+                     rank: int = -1,
+                     world_size: int = -1,
+                     timeout=None,
+                     verbose=True):
+    """Host control plane init — analog of ``deepspeed.init_distributed``
+    (comm/comm.py:604).  Multi-host JAX uses ``jax.distributed.initialize`` (the
+    rendezvous analog of the reference's NCCL TCP store); single-host is a no-op.
+
+    Env discovery: honors COORDINATOR_ADDRESS / JAX_COORDINATOR_ADDRESS plus the
+    reference's RANK/WORLD_SIZE spellings for familiarity.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    import os
+    coord = (init_method or os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if coord:
+        nproc = world_size if world_size > 0 else int(os.environ.get("WORLD_SIZE", "1"))
+        pid = rank if rank >= 0 else int(os.environ.get("RANK", "0"))
+        jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+        if verbose:
+            logger.info(f"jax.distributed initialized: process {pid}/{nproc} via {coord}")
+    from ..utils import logging as _logging
+    _logging.set_rank_provider(jax.process_index)
+    _INITIALIZED = True
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+def get_world_size(group=None) -> int:
+    """Host-process world size (device-level parallelism is the mesh's business)."""
+    return jax.process_count()
+
+
+def get_local_rank() -> int:
+    return 0  # one process per host owns all local chips in JAX
+
+
+def barrier(group=None):
+    """Synchronize all processes/devices (reference comm.py:521)."""
+    x = jnp.zeros(())
+    x.block_until_ready()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("dstpu_barrier")
+
+
+# --------------------------------------------------------------------------
+# In-graph collectives (usable under shard_map / pjit with named mesh axes)
+# --------------------------------------------------------------------------
+
+AxisArg = Union[str, Sequence[str]]
+
+
+def _trace_log(op: str, x) -> None:
+    cl = get_comms_logger()
+    if cl.should_profile(op):
+        try:
+            cl.record_traced(op, int(np.prod(x.shape)) * x.dtype.itemsize)
+        except Exception:
+            pass
+
+
+def all_reduce(x, axis: AxisArg, op: str = "sum"):
+    """lax.psum/pmax/pmin over a mesh axis (reference comm.py:478 all_reduce)."""
+    _trace_log("all_reduce", x)
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x, axis: AxisArg, *, tiled: bool = True, gather_dim: int = 0):
+    """Gather shards along a mesh axis (reference all_gather_into_tensor comm.py:308).
+    tiled=True concatenates along ``gather_dim`` (the flat-bucket layout ZeRO uses)."""
+    _trace_log("all_gather", x)
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisArg, *, scatter_dim: int = 0, tiled: bool = True):
+    """Reduce + scatter shards (reference reduce_scatter_fn comm.py:246)."""
+    _trace_log("reduce_scatter", x)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
+
+
+def all_to_all(x, axis: AxisArg, *, split_dim: int, concat_dim: int, tiled: bool = True):
+    """All-to-all over a mesh axis (reference all_to_all_single comm.py:334) —
+    the Ulysses/MoE dispatch primitive."""
+    _trace_log("all_to_all", x)
+    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
+
+
+def ppermute(x, axis: AxisArg, perm):
+    """Point-to-point ring shift — the TPU-native analog of pipeline p2p send/recv
+    (reference runtime/pipe/p2p.py:50,71); perm is [(src, dst), ...]."""
+    _trace_log("ppermute", x)
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: AxisArg):
+    return lax.axis_index(axis)
+
+
+def broadcast(x, axis: AxisArg, src: int = 0):
+    """Broadcast the src rank's shard to all ranks on the axis (comm.py:222).
+    Implemented as select + psum (ppermute requires unique sources; select rather
+    than multiply so non-src NaN/Inf shards cannot poison the sum)."""
+    _trace_log("broadcast", x)
+    idx = lax.axis_index(axis)
+    contribution = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(contribution, axis)
+
+
+# --------------------------------------------------------------------------
+# Host-level (eager) collectives over the global topology
+# --------------------------------------------------------------------------
+
+
+def _timed(op_name):
+
+    def deco(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, log_name=None, **kwargs):
+            cl = get_comms_logger()
+            if not cl.should_profile(op_name):
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            x = args[0]
+            size = int(np.prod(np.shape(x))) * jnp.asarray(x).dtype.itemsize
+            world = get_topology().world_size
+            cl.append(op_name, log_name or op_name, dt, size, world)
+            return out
+
+        return wrapper
+
+    return deco
+
+
+_REDUCERS = {
+    "sum": jnp.sum,
+    "avg": jnp.mean,
+    "mean": jnp.mean,
+    "max": jnp.max,
+    "min": jnp.min,
+    "prod": jnp.prod,
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _host_reduce_fn(op: str):
+    reducer = _REDUCERS[op]
+    return jax.jit(lambda v: reducer(v, axis=0))
+
+
+@_timed("all_reduce")
+def host_all_reduce(x, topo: Optional[MeshTopology] = None, op: str = "sum"):
+    """Eager reduction over the leading ("per-contributor") axis of a global array.
+
+    In single-controller JAX, arrays are globally consistent — there is no eager
+    per-rank value to reduce the way torch.distributed.all_reduce does.  The
+    control-plane uses (overflow consensus, loss averaging) stack contributions on
+    the leading axis; in-graph consensus belongs inside the jitted step via
+    ``all_reduce``.  The jitted reducer is cached per op (no per-call retrace).
+    """
+    if op not in _REDUCERS:
+        raise ValueError(f"unsupported reduce op {op!r}; one of {sorted(_REDUCERS)}")
+    if jnp.ndim(x) == 0:
+        raise ValueError("host_all_reduce expects a leading contributor axis; got a scalar")
+    return _host_reduce_fn(op)(x)
+
+
+def host_broadcast(x, topo: Optional[MeshTopology] = None):
+    """Replicate a host value across all devices (reference _broadcast_model
+    engine.py:1052 analog: rank0's value wins; with SPMD jax arrays the host value
+    is already consistent, so this is a device_put with replicated sharding)."""
+    topo = topo or get_topology()
+    return jax.device_put(x, topo.replicated())
+
+
+def log_summary(show_straggler=False):
+    """Reference dist.log_summary (comm/comm.py:422)."""
+    return get_comms_logger().log_summary(show_straggler=show_straggler)
+
+
+def configure(comms_config=None):
+    if comms_config is not None:
+        get_comms_logger().configure(comms_config)

@@ -1,0 +1,340 @@
+"""Framework configuration.
+
+TPU-native analog of the reference config system (deepspeed/runtime/config.py —
+``DeepSpeedConfig`` with ~80 ``get_*`` extractors plus pydantic sub-models).  A single
+JSON file or dict configures the whole engine; the batch-size triple
+``train_batch_size = micro_batch * gradient_accumulation_steps * dp_world_size``
+is reconciled exactly like the reference (runtime/config.py:837 ``_configure_train_batch_size``).
+
+TPU-specific extension: the ``mesh`` section declaring the device-mesh axis sizes
+(data/fsdp/tensor/sequence/expert/pipe) instead of the reference's implicit
+world-size + mpu plumbing.
+"""
+
+import json
+from typing import Any, Dict, List, Optional, Union
+
+from .config_utils import ConfigModel, Field
+from ..utils.logging import logger
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+class FP16Config(ConfigModel):
+    """Reference: deepspeed/runtime/fp16 config (runtime/config.py:125-180)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 => dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, ge=1)
+    hysteresis: int = Field(2, ge=1)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+
+
+class BF16Config(ConfigModel):
+    """Reference: bf16 section (runtime/config.py:162). TPU default-on happens in
+    TrainingConfig.model_validate when neither fp16 nor fp32 is requested."""
+    enabled: bool = True
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadParamConfig(ConfigModel):
+    """Reference: DeepSpeedZeroOffloadParamConfig (runtime/zero/offload_config.py:24)."""
+    device: str = Field("none", choices=("none", "cpu", "nvme"))
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=1)
+    buffer_size: int = Field(10**8, ge=1)
+    max_in_cpu: int = Field(10**9, ge=0)
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(ConfigModel):
+    """Reference: DeepSpeedZeroOffloadOptimizerConfig (runtime/zero/offload_config.py:52)."""
+    device: str = Field("none", choices=("none", "cpu", "nvme"))
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=1)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+
+class ZeroConfig(ConfigModel):
+    """Reference: DeepSpeedZeroConfig (runtime/zero/config.py) — stages, buckets,
+    ZeRO++ knobs (hpZ/qwZ/qgZ), offload sub-configs."""
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    round_robin_gradients: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = Field(int(1e9), ge=0)
+    prefetch_bucket_size: int = Field(int(5e7), ge=0, deprecated_names=("stage3_prefetch_bucket_size", ))
+    param_persistence_threshold: int = Field(int(1e5), ge=0, deprecated_names=("stage3_param_persistence_threshold", ))
+    model_persistence_threshold: int = Field(int(1e14), ge=0, deprecated_names=("stage3_model_persistence_threshold", ))
+    max_live_parameters: int = Field(int(1e9), ge=0, deprecated_names=("stage3_max_live_parameters", ))
+    max_reuse_distance: int = Field(int(1e9), ge=0, deprecated_names=("stage3_max_reuse_distance", ))
+    gather_16bit_weights_on_model_save: bool = Field(False,
+                                                    deprecated_names=("stage3_gather_16bit_weights_on_model_save", ))
+    ignore_unused_parameters: bool = True
+    # ZeRO++ analogs (reference runtime/zero/config.py:264-280)
+    zero_hpz_partition_size: int = Field(1, ge=1)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    mics_shard_size: int = Field(-1, deprecated_names=("mics_shard_size_", ))
+    mics_hierarchical_params_gather: bool = False
+    elastic_checkpoint: bool = False
+
+    def model_validate(self):
+        if self.overlap_comm is None:
+            # Reference defaults overlap_comm True for stage 3 (zero/config.py:308)
+            object.__setattr__(self, "overlap_comm", self.stage == 3)
+
+
+class ActivationCheckpointingConfig(ConfigModel):
+    """Reference: runtime/activation_checkpointing config (runtime/config.py:440)."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.checkpoint policy name applied to the layer scan.
+    policy: str = Field("nothing_saveable",
+                        choices=("everything_saveable", "nothing_saveable", "dots_saveable",
+                                 "dots_with_no_batch_dims_saveable", "checkpoint_dots",
+                                 "save_anything_except_these_names", "offload_dot"))
+
+
+class OptimizerConfig(ConfigModel):
+    allow_extra = True
+    type: str = "adamw"
+    params: Dict[str, Any] = Field(dict)
+
+
+class SchedulerConfig(ConfigModel):
+    allow_extra = True
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(dict)
+
+
+class CommsLoggerConfig(ConfigModel):
+    """Reference: DeepSpeedCommsConfig (comm/config.py)."""
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(list)
+
+
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class MonitorConfig(ConfigModel):
+    """Reference: DeepSpeedMonitorConfig (monitor/config.py)."""
+    tensorboard: TensorBoardConfig = Field(TensorBoardConfig)
+    wandb: WandbConfig = Field(WandbConfig)
+    csv_monitor: CSVConfig = Field(CSVConfig)
+
+
+class FlopsProfilerConfig(ConfigModel):
+    """Reference: DeepSpeedFlopsProfilerConfig (profiling/config.py)."""
+    enabled: bool = False
+    profile_step: int = Field(1, ge=0)
+    module_depth: int = -1
+    top_modules: int = Field(1, ge=1)
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class MeshConfig(ConfigModel):
+    """TPU-native: explicit device-mesh axis sizes.
+
+    Replaces the reference's world-size + mpu + groups plumbing
+    (deepspeed/utils/groups.py).  Any axis set to -1 absorbs the remaining
+    devices (at most one axis may be -1; default: data).
+    """
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
+    pipe: int = 1
+    # Axis order outer→inner; inner axes map to ICI-adjacent devices.
+    axis_order: List[str] = Field(lambda: ["pipe", "data", "fsdp", "expert", "sequence", "tensor"])
+
+    def model_validate(self):
+        sizes = self.axis_sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"MeshConfig: at most one axis may be -1, got {wild}")
+        for a, s in sizes.items():
+            if s < 1 and s != -1:
+                raise ValueError(f"MeshConfig.{a}={s} must be >=1 or -1")
+        known = set(sizes)
+        seen = set()
+        for a in self.axis_order:
+            if a not in known:
+                raise ValueError(f"MeshConfig.axis_order: unknown axis {a!r}; valid axes: {sorted(known)}")
+            if a in seen:
+                raise ValueError(f"MeshConfig.axis_order: duplicate axis {a!r}")
+            seen.add(a)
+
+    def axis_sizes(self):
+        return {a: getattr(self, a) for a in ("data", "fsdp", "tensor", "sequence", "expert", "pipe")}
+
+
+class GradientCompressionConfig(ConfigModel):
+    """1-bit style compressed gradient reduction (reference runtime/comm/nccl.py:51)."""
+    enabled: bool = False
+    freeze_step: int = Field(100, ge=0)
+
+
+class DataEfficiencyConfig(ConfigModel):
+    allow_extra = True
+    enabled: bool = False
+
+
+class TrainingConfig(ConfigModel):
+    """Top-level config — analog of ``DeepSpeedConfig`` (runtime/config.py:687).
+
+    Accepts the same key spellings as a DeepSpeed JSON config where the concept
+    carries over.  Unknown top-level keys are accepted with a loud warning (so
+    reference configs with not-yet-modeled sections still load); sub-models are
+    strict and raise, matching the reference's per-section validation.
+    """
+    allow_extra = "warn"
+
+    train_batch_size: Optional[int] = Field(None, ge=1)
+    train_micro_batch_size_per_gpu: Optional[int] = Field(None, ge=1)
+    gradient_accumulation_steps: Optional[int] = Field(None, ge=1)
+    steps_per_print: int = Field(10, ge=1)
+    gradient_clipping: float = Field(0.0, ge=0.0)
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = Field(1.0, gt=0.0)
+    sparse_gradients: bool = False
+    communication_data_type: Optional[str] = None
+    seed: int = 1234
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(FP16Config)
+    bf16: Optional[BF16Config] = None
+    zero_optimization: ZeroConfig = Field(ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = Field(CommsLoggerConfig)
+    monitor_config: Optional[MonitorConfig] = None
+    tensorboard: TensorBoardConfig = Field(TensorBoardConfig)
+    wandb: WandbConfig = Field(WandbConfig)
+    csv_monitor: CSVConfig = Field(CSVConfig)
+    flops_profiler: FlopsProfilerConfig = Field(FlopsProfilerConfig)
+    mesh: MeshConfig = Field(MeshConfig)
+    gradient_compression: GradientCompressionConfig = Field(GradientCompressionConfig)
+    data_efficiency: DataEfficiencyConfig = Field(DataEfficiencyConfig)
+
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    checkpoint_tag_validation: str = Field("Warn", choices=("Ignore", "Warn", "Fail", "ignore", "warn", "fail"))
+    load_universal_checkpoint: bool = False
+    use_node_local_storage: bool = False
+    elasticity: Optional[Dict[str, Any]] = None
+    autotuning: Optional[Dict[str, Any]] = None
+
+    def model_validate(self):
+        if self.fp16.enabled and self.bf16 is not None and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.bf16 is None:
+            # TPU-first default: bf16 on unless fp16 explicitly requested.
+            object.__setattr__(self, "bf16", BF16Config(enabled=not self.fp16.enabled))
+
+    # --- batch-size triple reconciliation (reference runtime/config.py:837) ---
+    def resolve_batch_sizes(self, dp_world_size: int):
+        """Return (train_batch, micro_batch, gas), solving for any missing member of
+        train_batch = micro_batch * gas * dp_world_size; raises on inconsistency."""
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"train_batch_size={tb} != micro_batch({mb}) * gas({gas}) * dp_world({dp_world_size})")
+        elif tb is not None and mb is not None:
+            if tb % (mb * dp_world_size) != 0:
+                raise ValueError(f"train_batch_size={tb} not divisible by micro_batch*dp={mb * dp_world_size}")
+            gas = tb // (mb * dp_world_size)
+        elif tb is not None and gas is not None:
+            if tb % (gas * dp_world_size) != 0:
+                raise ValueError(f"train_batch_size={tb} not divisible by gas*dp={gas * dp_world_size}")
+            mb = tb // (gas * dp_world_size)
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            mb = tb // dp_world_size
+            if mb == 0 or tb % dp_world_size != 0:
+                raise ValueError(f"train_batch_size={tb} not divisible by dp_world_size={dp_world_size}")
+            gas = 1
+        else:
+            raise ValueError("One of train_batch_size or train_micro_batch_size_per_gpu must be set")
+        object.__setattr__(self, "train_batch_size", tb)
+        object.__setattr__(self, "train_micro_batch_size_per_gpu", mb)
+        object.__setattr__(self, "gradient_accumulation_steps", gas)
+        return tb, mb, gas
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16 is not None and self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+
+def load_config(config: Union[str, dict, TrainingConfig, None]) -> TrainingConfig:
+    """Parse a config path / dict / model into a TrainingConfig.
+
+    Analog of DeepSpeedConfig.__init__ (runtime/config.py:699) accepting either a
+    JSON file path or an already-parsed dict.
+    """
+    if config is None:
+        return TrainingConfig()
+    if isinstance(config, TrainingConfig):
+        return config
+    if isinstance(config, str):
+        with open(config, "r") as fh:
+            config = json.load(fh)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be a path, dict, or TrainingConfig; got {type(config)}")
+    known_zero_aliases = {"zero_allow_untested_optimizer", "zero_force_ds_cpu_optimizer"}
+    config = {k: v for k, v in config.items() if k not in known_zero_aliases}
+    return TrainingConfig(**config)

@@ -1,0 +1,227 @@
+"""Typed config-model base.
+
+TPU-native analog of the reference's ``DeepSpeedConfigModel``
+(deepspeed/runtime/config_utils.py:16), which is built on pydantic v1 and supports
+deprecated-field aliasing/migration.  We implement a small dependency-free model:
+class annotations declare fields, ``Field(default, deprecated_names=[...])`` adds
+aliases, ``validate_<name>`` methods run per-field checks, and unknown keys raise
+unless the subclass sets ``allow_extra = True``.
+"""
+
+import copy
+import dataclasses
+import typing
+from typing import Any, Dict, List, Optional, Union
+
+from ..utils.logging import logger
+
+
+class _MISSING:
+
+    def __repr__(self):
+        return "<required>"
+
+
+MISSING = _MISSING()
+
+
+@dataclasses.dataclass
+class Field:
+    default: Any = MISSING
+    deprecated_names: tuple = ()
+    ge: Optional[float] = None
+    gt: Optional[float] = None
+    le: Optional[float] = None
+    choices: Optional[tuple] = None
+    # Set when this field itself is deprecated; reads/writes warn.
+    deprecated: bool = False
+
+    def resolve_default(self):
+        if callable(self.default) and self.default is not MISSING:
+            return self.default()
+        return copy.deepcopy(self.default) if isinstance(self.default, (list, dict)) else self.default
+
+
+def _origin(tp):
+    return typing.get_origin(tp)
+
+
+def _args(tp):
+    return typing.get_args(tp)
+
+
+def _coerce(value, tp, path):
+    """Best-effort coercion of a JSON value into the annotated type."""
+    if tp is Any or value is None:
+        return value
+    origin = _origin(tp)
+    if origin is Union:
+        args = [a for a in _args(tp) if a is not type(None)]
+        for a in args:
+            try:
+                return _coerce(value, a, path)
+            except (TypeError, ValueError):
+                continue
+        raise TypeError(f"{path}: cannot coerce {value!r} to {tp}")
+    if origin in (list, List):
+        (elem_tp, ) = _args(tp) or (Any, )
+        if not isinstance(value, (list, tuple)):
+            raise TypeError(f"{path}: expected list, got {type(value).__name__}")
+        return [_coerce(v, elem_tp, f"{path}[{i}]") for i, v in enumerate(value)]
+    if origin in (dict, Dict):
+        return dict(value)
+    if origin is tuple:
+        return tuple(value)
+    if isinstance(tp, type) and issubclass(tp, ConfigModel):
+        if isinstance(value, tp):
+            return value
+        if isinstance(value, dict):
+            return tp(**value)
+        raise TypeError(f"{path}: expected dict for {tp.__name__}, got {type(value).__name__}")
+    if tp is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str) and value.lower() in ("true", "false"):
+            return value.lower() == "true"
+        raise TypeError(f"{path}: expected bool, got {value!r}")
+    if tp is int:
+        if isinstance(value, bool):
+            raise TypeError(f"{path}: expected int, got bool")
+        if isinstance(value, int):
+            return value
+        if isinstance(value, float) and value.is_integer():
+            return int(value)
+        if isinstance(value, str):
+            return int(float(value)) if float(value).is_integer() else _fail_int(path, value)
+        raise TypeError(f"{path}: expected int, got {value!r}")
+    if tp is float:
+        if isinstance(value, bool):
+            raise TypeError(f"{path}: expected float, got bool")
+        if isinstance(value, (int, float)):
+            return float(value)
+        if isinstance(value, str):
+            return float(value)
+        raise TypeError(f"{path}: expected float, got {value!r}")
+    if tp is str:
+        if isinstance(value, str):
+            return value
+        raise TypeError(f"{path}: expected str, got {value!r}")
+    if isinstance(tp, type):
+        if isinstance(value, tp):
+            return value
+        try:
+            return tp(value)
+        except Exception as e:
+            raise TypeError(f"{path}: cannot coerce {value!r} to {tp}: {e}") from e
+    return value
+
+
+def _fail_int(path, value):
+    raise TypeError(f"{path}: expected int, got {value!r}")
+
+
+class ConfigModel:
+    """Declarative config base: annotate fields on the subclass body.
+
+    >>> class MyConf(ConfigModel):
+    ...     enabled: bool = False
+    ...     size: int = Field(8, ge=1, deprecated_names=("sz",))
+    """
+
+    allow_extra = False
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        fields = {}
+        for klass in reversed(cls.__mro__):
+            for name, tp in getattr(klass, "__annotations__", {}).items():
+                if name.startswith("_") or name == "allow_extra":
+                    continue
+                raw = klass.__dict__.get(name, MISSING)
+                field = raw if isinstance(raw, Field) else Field(default=raw)
+                fields[name] = (tp, field)
+        cls._fields = fields
+        cls._aliases = {}
+        for name, (_tp, field) in fields.items():
+            for alias in field.deprecated_names:
+                cls._aliases[alias] = name
+
+    def __init__(self, **kwargs):
+        cls = type(self)
+        data = {}
+        extra = {}
+        for key, value in kwargs.items():
+            if key in cls._aliases:
+                new = cls._aliases[key]
+                logger.warning(f"Config field '{key}' is deprecated, use '{new}'", extra={"once": True})
+                key = new
+            if key in cls._fields:
+                data[key] = value
+            elif cls.allow_extra:
+                if cls.allow_extra == "warn":
+                    logger.warning(f"{cls.__name__}: ignoring unknown config field '{key}'",
+                                   extra={"once": True})
+                extra[key] = value
+            else:
+                raise ValueError(f"{cls.__name__}: unknown config field '{key}'. "
+                                 f"Valid fields: {sorted(cls._fields)}")
+        for name, (tp, field) in cls._fields.items():
+            if name in data:
+                value = _coerce(data[name], tp, f"{cls.__name__}.{name}")
+            elif field.default is MISSING:
+                raise ValueError(f"{cls.__name__}: missing required field '{name}'")
+            else:
+                value = field.resolve_default()
+            self._check_bounds(name, field, value)
+            validator = getattr(self, f"validate_{name}", None)
+            if validator is not None:
+                value = validator(value)
+            object.__setattr__(self, name, value)
+        object.__setattr__(self, "_extra", extra)
+        self.model_validate()
+
+    def _check_bounds(self, name, field, value):
+        if value is None or not isinstance(value, (int, float)) or isinstance(value, bool):
+            pass
+        else:
+            label = f"{type(self).__name__}.{name}"
+            if field.ge is not None and value < field.ge:
+                raise ValueError(f"{label}={value} must be >= {field.ge}")
+            if field.gt is not None and value <= field.gt:
+                raise ValueError(f"{label}={value} must be > {field.gt}")
+            if field.le is not None and value > field.le:
+                raise ValueError(f"{label}={value} must be <= {field.le}")
+        if field.choices is not None and value not in field.choices:
+            raise ValueError(f"{type(self).__name__}.{name}={value!r} not in {field.choices}")
+
+    def model_validate(self):
+        """Subclass hook for cross-field validation."""
+
+    def to_dict(self):
+        out = {}
+        for name in type(self)._fields:
+            value = getattr(self, name)
+            if isinstance(value, ConfigModel):
+                value = value.to_dict()
+            elif isinstance(value, list):
+                value = [v.to_dict() if isinstance(v, ConfigModel) else v for v in value]
+            out[name] = value
+        out.update(self._extra)
+        return out
+
+    def replace(self, **updates):
+        data = self.to_dict()
+        data.update(updates)
+        return type(self)(**data)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in type(self)._fields)
+        return f"{type(self).__name__}({inner})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+
+def get_scalar_param(param_dict, name, default):
+    """Reference-parity helper (deepspeed/runtime/config_utils.py:41)."""
+    return param_dict.get(name, default)

@@ -1,0 +1,100 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (``logger``,
+``log_dist``).  Rank filtering uses ``jax.process_index()`` instead of
+``torch.distributed.get_rank()``.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _NoDuplicateFilter(logging.Filter):
+    """Filter out exact-duplicate warn-once style records."""
+
+    def __init__(self):
+        super().__init__()
+        self._seen = set()
+
+    def filter(self, record):
+        if getattr(record, "once", False):
+            key = (record.levelno, record.getMessage())
+            if key in self._seen:
+                return False
+            self._seen.add(key)
+        return True
+
+
+def _create_logger(name="deepspeed_tpu", level=None):
+    logger_ = logging.getLogger(name)
+    if logger_.handlers:
+        return logger_
+    level = level if level is not None else log_levels.get(LOG_LEVEL.lower(), logging.INFO)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(
+        logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S",
+        ))
+    logger_.addHandler(handler)
+    logger_.addFilter(_NoDuplicateFilter())
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _env_rank():
+    return int(os.environ.get("RANK", os.environ.get("JAX_PROCESS_INDEX", "0")))
+
+
+# Overridden by comm.init_distributed once the backend is up; reading the env
+# before then avoids forcing jax backend initialization from a log call (and
+# avoids caching a pre-init rank for the process lifetime).
+_rank_provider = _env_rank
+
+
+def set_rank_provider(fn):
+    global _rank_provider
+    _rank_provider = fn
+
+
+def _process_index():
+    try:
+        return _rank_provider()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process indices (None or [-1] = all).
+
+    Mirrors the reference ``log_dist`` contract (deepspeed/utils/logging.py:108):
+    rank filtering against the distributed rank; here the host process index.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message):
+    logger.warning(message, extra={"once": True})
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        logger.info(message)

@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+Analog of the reference's distributed-test harness (tests/unit/common.py): the
+reference launches N real ranks on one host; on TPU-native JAX we instead simulate
+an 8-device mesh on CPU via XLA host-platform device partitioning — the pattern the
+reference's accelerator-portable suite enables (tests/unit/common.py:111).
+
+MUST run before any jax import, hence module-level env mutation in conftest.
+"""
+
+import os
+
+# Force CPU for tests even when the session env preselects the TPU platform
+# (JAX_PLATFORMS=axon); bench.py / production use the real chip.  sitecustomize
+# may import jax before this file runs, so env alone isn't enough — backend init
+# is lazy, so flipping jax.config before the first device query still works.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_topology():
+    yield
+    from deepspeed_tpu.parallel import reset_topology
+    reset_topology()
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-device (data=8) topology."""
+    from deepspeed_tpu.parallel import MeshTopology
+    return MeshTopology.from_axis_dict({"data": 8})
+
+
+@pytest.fixture
+def mesh_2x4():
+    from deepspeed_tpu.parallel import MeshTopology
+    return MeshTopology.from_axis_dict({"data": 2, "tensor": 4})

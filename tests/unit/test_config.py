@@ -1,0 +1,118 @@
+"""Config-system tests — analog of tests/unit/runtime/test_ds_config_dict.py."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (BF16Config, FP16Config, MeshConfig, TrainingConfig, ZeroConfig, load_config)
+
+
+def test_defaults():
+    cfg = TrainingConfig()
+    assert cfg.zero_optimization.stage == 0
+    assert cfg.bf16.enabled  # TPU-first default
+    assert not cfg.fp16.enabled
+    assert cfg.gradient_clipping == 0.0
+
+
+def test_load_from_dict():
+    cfg = load_config({
+        "train_batch_size": 32,
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": 1000},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": False},
+    })
+    assert cfg.train_batch_size == 32
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.reduce_bucket_size == 1000
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.optimizer.params["lr"] == 1e-3
+
+
+def test_load_from_json_file(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4, "zero_optimization": {"stage": 3}}))
+    cfg = load_config(str(path))
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_reconciliation_solves_gas():
+    cfg = TrainingConfig(train_batch_size=64, train_micro_batch_size_per_gpu=2)
+    tb, mb, gas = cfg.resolve_batch_sizes(dp_world_size=8)
+    assert (tb, mb, gas) == (64, 2, 4)
+
+
+def test_batch_reconciliation_solves_micro():
+    cfg = TrainingConfig(train_batch_size=64, gradient_accumulation_steps=2)
+    tb, mb, gas = cfg.resolve_batch_sizes(dp_world_size=8)
+    assert (tb, mb, gas) == (64, 4, 2)
+
+
+def test_batch_reconciliation_solves_total():
+    cfg = TrainingConfig(train_micro_batch_size_per_gpu=4)
+    tb, mb, gas = cfg.resolve_batch_sizes(dp_world_size=8)
+    assert (tb, mb, gas) == (32, 4, 1)
+
+
+def test_batch_reconciliation_inconsistent_raises():
+    cfg = TrainingConfig(train_batch_size=64, train_micro_batch_size_per_gpu=3, gradient_accumulation_steps=2)
+    with pytest.raises(ValueError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_batch_required():
+    with pytest.raises(ValueError):
+        TrainingConfig().resolve_batch_sizes(dp_world_size=8)
+
+
+def test_fp16_bf16_mutually_exclusive():
+    with pytest.raises(ValueError):
+        TrainingConfig(fp16={"enabled": True}, bf16={"enabled": True})
+
+
+def test_fp16_enables_disables_bf16_default():
+    cfg = TrainingConfig(fp16={"enabled": True})
+    assert not cfg.bf16.enabled
+    import jax.numpy as jnp
+    assert cfg.precision_dtype == jnp.float16
+
+
+def test_unknown_field_raises_in_strict_models():
+    with pytest.raises(ValueError):
+        ZeroConfig(bogus_field=1)
+
+
+def test_deprecated_alias():
+    z = ZeroConfig(stage3_prefetch_bucket_size=123)
+    assert z.prefetch_bucket_size == 123
+
+
+def test_bounds_check():
+    with pytest.raises(ValueError):
+        ZeroConfig(stage=7)
+
+
+def test_zero_overlap_comm_default_by_stage():
+    assert ZeroConfig(stage=3).overlap_comm is True
+    assert ZeroConfig(stage=1).overlap_comm is False
+    assert ZeroConfig(stage=1, overlap_comm=True).overlap_comm is True
+
+
+def test_mesh_config_wildcard_validation():
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, tensor=-1)
+
+
+def test_to_dict_roundtrip():
+    cfg = load_config({"train_batch_size": 8, "zero_optimization": {"stage": 1}})
+    cfg2 = load_config(cfg.to_dict())
+    assert cfg2.zero_optimization.stage == 1
+    assert cfg2.train_batch_size == 8
+
+
+def test_type_coercion():
+    z = ZeroConfig(reduce_bucket_size=5e8, stage="2")
+    assert z.reduce_bucket_size == int(5e8)
+    assert z.stage == 2

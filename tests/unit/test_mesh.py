@@ -1,0 +1,61 @@
+"""Mesh/topology tests — analog of tests/unit/runtime/pipe/test_topology.py."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.parallel import (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, MeshTopology, get_topology, set_topology)
+from deepspeed_tpu.runtime.config import MeshConfig
+
+
+def test_default_mesh_all_data():
+    topo = MeshTopology.build()
+    assert topo.world_size == 8
+    assert topo.axis_size(DATA_AXIS) == 8
+    assert topo.axis_size(TENSOR_AXIS) == 1
+
+
+def test_explicit_axes():
+    topo = MeshTopology.from_axis_dict({"data": 2, "tensor": 4})
+    assert topo.axis_size(DATA_AXIS) == 2
+    assert topo.axis_size(TENSOR_AXIS) == 4
+    assert topo.get_model_parallel_world_size() == 4
+    assert topo.get_data_parallel_world_size() == 2
+
+
+def test_wildcard_absorbs_remainder():
+    topo = MeshTopology.build(MeshConfig(data=-1, tensor=2))
+    assert topo.axis_size(DATA_AXIS) == 4
+    assert topo.axis_size(TENSOR_AXIS) == 2
+
+
+def test_mismatched_sizes_raise():
+    with pytest.raises(ValueError):
+        MeshTopology.build(MeshConfig(data=3, tensor=5))
+
+
+def test_fsdp_counts_into_dp_world():
+    topo = MeshTopology.from_axis_dict({"data": 2, "fsdp": 4})
+    assert topo.get_data_parallel_world_size() == 8
+    assert topo.data_parallel_axes() == (DATA_AXIS, FSDP_AXIS)
+
+
+def test_seq_data_parallel_world():
+    topo = MeshTopology.from_axis_dict({"data": 2, "sequence": 4})
+    assert topo.get_sequence_data_parallel_world_size() == 8
+
+
+def test_sharding_helpers():
+    topo = MeshTopology.from_axis_dict({"data": 8})
+    sh = topo.sharding(PartitionSpec("data"))
+    x = jax.device_put(np.arange(16.0).reshape(8, 2), sh)
+    assert x.sharding.spec == PartitionSpec("data")
+    rep = jax.device_put(np.ones(4), topo.replicated())
+    np.testing.assert_array_equal(np.asarray(rep), np.ones(4))
+
+
+def test_global_topology_registry():
+    topo = MeshTopology.from_axis_dict({"data": 8})
+    set_topology(topo)
+    assert get_topology() is topo
