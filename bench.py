@@ -1,0 +1,76 @@
+"""Benchmark — prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Runs a ZeRO-sharded training step on the available device(s) and reports
+training throughput.  (Flagship-model MFU benchmark lands with the model
+family; this measures the engine's step machinery end to end.)
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+
+    hidden, nlayers = 1024, 4
+
+    def init_params(key):
+        import jax.numpy as jnp
+        params = {}
+        keys = jax.random.split(key, nlayers)
+        for i in range(nlayers):
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(keys[i], (hidden, hidden), jnp.float32) * 0.02,
+                "b": jnp.zeros((hidden, )),
+            }
+        return params
+
+    def loss_fn(params, batch, rng):
+        import jax.numpy as jnp
+        h = batch["x"]
+        for i in range(nlayers):
+            p = params[f"layer_{i}"]
+            h = jax.nn.relu(h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype))
+        return jnp.mean((h - batch["y"].astype(h.dtype))**2).astype(jnp.float32)
+
+    params = init_params(jax.random.PRNGKey(0))
+    micro = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.normal(size=(engine.train_batch_size, hidden)).astype(np.float32),
+        "y": rng.normal(size=(engine.train_batch_size, hidden)).astype(np.float32),
+    }
+    # warmup/compile
+    for _ in range(3):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    dt = time.perf_counter() - t0
+    samples_per_sec = steps * engine.train_batch_size / dt
+    print(json.dumps({
+        "metric": "zero1_mlp_train_throughput",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

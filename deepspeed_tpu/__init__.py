@@ -1,0 +1,75 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Public API analog of deepspeed/__init__.py: ``initialize()`` returns
+(engine, optimizer, dataloader, lr_scheduler); ``init_distributed`` is re-exported
+from comm (reference __init__.py:64,263).
+"""
+
+__version__ = "0.1.0"
+
+from typing import Any, Callable, Optional, Tuple
+
+from . import comm
+from .comm import init_distributed
+from .parallel.mesh import MeshTopology
+from .runtime.config import TrainingConfig, load_config
+from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+
+
+def initialize(args=None,
+               model: Optional[Callable] = None,
+               loss_fn: Optional[Callable] = None,
+               model_parameters: Any = None,
+               training_data=None,
+               config=None,
+               topology: Optional[MeshTopology] = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               **kwargs):
+    """Build a training engine (reference deepspeed.initialize, __init__.py:64).
+
+    TPU-native contract: the model is a pure loss function
+    ``loss_fn(params, batch, rng) -> loss`` (or ``(loss, aux)``) over a params
+    pytree — pass it as ``loss_fn`` (or as ``model`` if it's callable; objects
+    exposing ``.loss_fn`` — e.g. deepspeed_tpu.models — are unwrapped).
+
+    Returns (engine, optimizer, training_dataloader, lr_scheduler) like the
+    reference; optimizer/lr_scheduler live inside the engine (functional state)
+    and are surfaced for API parity.
+    """
+    from .runtime.engine import Engine
+
+    if dist_init_required is None or dist_init_required:
+        init_distributed()
+
+    cfg = load_config(config)
+    if args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config and config is None:
+        cfg = load_config(args.deepspeed_config)
+
+    fn = loss_fn
+    if fn is None and model is not None:
+        fn = getattr(model, "loss_fn", model if callable(model) else None)
+    if fn is None:
+        raise ValueError("initialize() needs loss_fn (or a callable/loss_fn-bearing model)")
+    if model_parameters is None:
+        model_parameters = getattr(model, "params", None)
+    if model_parameters is None:
+        raise ValueError("initialize() needs model_parameters (the params pytree)")
+
+    engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology)
+
+    dataloader = None
+    if training_data is not None:
+        dataloader = DeepSpeedDataLoader(training_data,
+                                         batch_size=engine.train_batch_size,
+                                         seed=cfg.seed,
+                                         collate_fn=collate_fn)
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Reference add_config_arguments (__init__.py:240)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true")
+    group.add_argument("--deepspeed_config", default=None, type=str)
+    return parser

@@ -1,0 +1,113 @@
+"""Experiment monitoring.
+
+Analog of deepspeed/monitor/ (``Monitor`` ABC monitor.py:13, ``MonitorMaster:29``
+fan-out to TensorBoard / W&B / CSV writers).  Events are ``(tag, value, step)``
+triples; only process 0 writes (reference checks dist.get_rank()==0).
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """Uses tensorboardX/torch SummaryWriter when importable, else disables
+    itself (the env may not ship tensorboard)."""
+
+    def __init__(self, config):
+        self.enabled = False
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+            path = os.path.join(config.output_path or "runs", config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"TensorBoard monitor disabled: {e}", extra={"once": True})
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, config):
+        self.enabled = False
+        try:
+            import wandb  # type: ignore
+            wandb.init(project=config.project, group=config.group, entity=config.team)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"W&B monitor disabled: {e}", extra={"once": True})
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self._wandb.log({tag: value}, step=step)
+
+
+class csvMonitor(Monitor):
+    """CSV writer (reference monitor/csv_monitor.py) — one file per tag."""
+
+    def __init__(self, config):
+        self.output_path = os.path.join(config.output_path or "csv_logs", config.job_name)
+        os.makedirs(self.output_path, exist_ok=True)
+        self.enabled = True
+
+    def write_events(self, events: List[Event]):
+        for tag, value, step in events:
+            fname = os.path.join(self.output_path, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out (reference monitor/monitor.py:29); rank-0 only."""
+
+    def __init__(self, training_config):
+        self.monitors: List[Monitor] = []
+        import jax
+        try:
+            is_rank0 = jax.process_index() == 0
+        except Exception:
+            is_rank0 = True
+        if not is_rank0:
+            return
+        mc = training_config.monitor_config
+        tb = mc.tensorboard if mc else training_config.tensorboard
+        wb = mc.wandb if mc else training_config.wandb
+        cv = mc.csv_monitor if mc else training_config.csv_monitor
+        if tb.enabled:
+            self.monitors.append(TensorBoardMonitor(tb))
+        if wb.enabled:
+            self.monitors.append(WandbMonitor(wb))
+        if cv.enabled:
+            self.monitors.append(csvMonitor(cv))
+
+    @property
+    def enabled(self):
+        return bool(self.monitors)
+
+    def write_events(self, events: List[Event]):
+        for m in self.monitors:
+            m.write_events(events)

@@ -1,0 +1,141 @@
+"""Checkpoint save/load.
+
+Analog of the reference checkpoint layer (engine.save_checkpoint:3052 /
+load_checkpoint:2688, checkpoint_engine/).  Layout mirrors the reference's
+directory scheme (``<dir>/<tag>/`` + a ``latest`` tag file, engine.py:2632), but
+the payload is **topology-free**: every leaf of the train state is written as a
+full (unsharded) ``.npy`` keyed by its pytree path.  On load, leaves are placed
+with the *current* plan's shardings — so resuming on a different dp world size /
+zero stage works by construction (the reference needs ``zero_elastic_checkpoint``
+and the universal-checkpoint converter for this; here reshape-on-load is the
+native behavior, and the universal format in deepspeed_tpu/checkpoint/ adds
+tp/pp-aware merging on top).
+
+Large leaves are gathered to host one at a time to bound peak host memory.
+"""
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _leaf_key(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    key = ".".join(parts)
+    return re.sub(r"[^A-Za-z0-9_.\-]", "_", key)
+
+
+def _is_rank0() -> bool:
+    try:
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def save_checkpoint_dir(save_dir: str, tag: str, state, client_state: Dict, config=None):
+    """Write the full state under ``save_dir/tag/`` and update ``latest``."""
+    ckpt_dir = os.path.join(save_dir, tag)
+    if _is_rank0():
+        os.makedirs(ckpt_dir, exist_ok=True)
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
+    manifest = []
+    for path, leaf in leaves_with_path:
+        key = _leaf_key(path)
+        arr = _gather_to_host(leaf)
+        if _is_rank0():
+            np.save(os.path.join(ckpt_dir, key + ".npy"), arr)
+        manifest.append({"key": key, "shape": list(arr.shape), "dtype": str(arr.dtype)})
+    if _is_rank0():
+        meta = {"manifest": manifest, "client_state": _jsonable(client_state)}
+        with open(os.path.join(ckpt_dir, "metadata.json"), "w") as fh:
+            json.dump(meta, fh, indent=1)
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
+            fh.write(tag)
+    log_dist(f"saved checkpoint {tag} -> {ckpt_dir} ({len(manifest)} leaves)", ranks=[0])
+
+
+def _gather_to_host(leaf) -> np.ndarray:
+    if isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1:
+        rep = NamedSharding(leaf.sharding.mesh, PartitionSpec())
+        leaf = jax.device_put(leaf, rep)
+    return np.asarray(leaf)
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer, np.floating)):
+        return obj.item()
+    return obj
+
+
+def get_latest_tag(load_dir: str) -> Optional[str]:
+    path = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return fh.read().strip()
+
+
+def load_checkpoint_dir(load_dir: str,
+                        tag: Optional[str],
+                        state_template,
+                        target_shardings,
+                        load_optimizer_states: bool = True) -> Tuple[Any, Dict]:
+    """Rebuild a train state from disk, placing each leaf with the current plan's
+    sharding (elastic/reshaping load).  ``state_template`` supplies the pytree
+    structure; ``load_optimizer_states=False`` keeps the template's optimizer
+    state/loss scale and loads only params+step (reference load_checkpoint:2688
+    ``load_optimizer_states`` arg)."""
+    tag = tag or get_latest_tag(load_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {load_dir} and no tag given")
+    ckpt_dir = os.path.join(load_dir, tag)
+    with open(os.path.join(ckpt_dir, "metadata.json")) as fh:
+        meta = json.load(fh)
+    available = {m["key"] for m in meta["manifest"]}
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    shard_leaves = jax.tree_util.tree_leaves(target_shardings)
+    assert len(shard_leaves) == len(leaves_with_path), \
+        f"sharding tree ({len(shard_leaves)}) != state tree ({len(leaves_with_path)})"
+
+    new_leaves = []
+    for (path, cur_leaf), sharding in zip(leaves_with_path, shard_leaves):
+        key = _leaf_key(path)
+        top = key.split(".")[0]
+        skip = (not load_optimizer_states) and top in ("opt_state", "loss_scale")
+        if skip or key not in available:
+            if key not in available and not skip:
+                logger.warning(f"checkpoint missing leaf {key}; keeping current value")
+            new_leaves.append(cur_leaf)
+            continue
+        arr = np.load(os.path.join(ckpt_dir, key + ".npy"))
+        expected = tuple(np.shape(cur_leaf))
+        if tuple(arr.shape) != expected:
+            raise ValueError(f"checkpoint leaf {key} shape {arr.shape} != model shape {expected}")
+        arr = arr.astype(np.asarray(cur_leaf).dtype) if hasattr(cur_leaf, "dtype") else arr
+        new_leaves.append(jax.device_put(arr, sharding))
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
+    return state, meta.get("client_state", {})

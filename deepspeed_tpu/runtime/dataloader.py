@@ -1,0 +1,95 @@
+"""Data loaders.
+
+Analog of deepspeed/runtime/dataloader.py (``DeepSpeedDataLoader:41``,
+``RepeatingLoader:17``).  The reference wraps a torch DataLoader with a
+DistributedSampler; in single-controller JAX every process assembles the GLOBAL
+macro-batch [train_batch_size, ...] and the engine shards it over the dp mesh
+axes at device_put time — so the loader's job is batching + shuffling + resume,
+not rank slicing.
+"""
+
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference dataloader.py:17)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Global-batch loader over an indexable dataset.
+
+    dataset[i] returns a pytree sample (dict/tuple of arrays); batches are
+    collated by stacking.  ``state_dict``/``load_state_dict`` support
+    curriculum-style resume (reference: curriculum-aware resume in
+    runtime/dataloader.py + data_sampler).
+    """
+
+    def __init__(self,
+                 dataset: Sequence,
+                 batch_size: int,
+                 shuffle: bool = True,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.epoch = 0
+        self._consumed_in_epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def _order(self):
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator:
+        order = self._order()
+        start = self._consumed_in_epoch * self.batch_size
+        for ofs in range(start, len(self.dataset) - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+            batch_idx = order[ofs:ofs + self.batch_size]
+            if len(batch_idx) == 0:
+                break
+            self._consumed_in_epoch += 1
+            yield self.collate_fn([self.dataset[int(i)] for i in batch_idx])
+        self.epoch += 1
+        self._consumed_in_epoch = 0
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed_in_epoch": self._consumed_in_epoch, "seed": self.seed}
+
+    def load_state_dict(self, sd):
+        self.epoch = sd["epoch"]
+        self._consumed_in_epoch = sd["consumed_in_epoch"]
+        self.seed = sd["seed"]
+
+
+def _default_collate(samples):
+    import jax
+    return jax.tree_util.tree_map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *samples)

@@ -1,0 +1,180 @@
+"""Learning-rate schedules.
+
+Analog of deepspeed/runtime/lr_schedules.py (``LRRangeTest:267``, ``OneCycle:370``,
+``WarmupLR:634``, ``WarmupDecayLR:723``, ``WarmupCosineLR:774``).  TPU-native
+design: each schedule is a pure ``step -> lr`` function (jnp-traceable, usable
+inside the jitted train step), wrapped in a small object with the reference's
+``get_lr()/step()`` surface for imperative callers.
+
+Config spelling matches the reference scheduler "params" dicts.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Callable:
+    """Reference LRRangeTest (lr_schedules.py:267): lr = min_lr * (1 + rate*interval)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = jnp.floor(step / lr_range_test_step_size) if lr_range_test_staircase \
+            else step / lr_range_test_step_size
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float,
+              cycle_max_lr: float,
+              decay_lr_rate: float = 0.0,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0,
+              cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0,
+              **_ignored) -> Callable:
+    """Reference OneCycle (lr_schedules.py:370): ramp min→max over the first phase,
+    max→min over the second, then decay by decay_lr_rate per decay_step_size."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        in_first = step < cycle_first_step_size
+        frac_up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        frac_down = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        lr_up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * frac_up
+        lr_down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * frac_down
+        lr_cycle = jnp.where(in_first, lr_up, lr_down)
+        post = jnp.maximum(step - total_cycle, 0.0)
+        if decay_lr_rate > 0.0 and decay_step_size > 0:
+            decay = 1.0 / (1.0 + decay_lr_rate * jnp.floor(post / decay_step_size))
+            lr_post = cycle_min_lr * decay
+        else:
+            lr_post = jnp.asarray(cycle_min_lr, jnp.float32)
+        return jnp.where(step < total_cycle, lr_cycle, lr_post)
+
+    return schedule
+
+
+def warmup_lr(warmup_min_lr: float = 0.0,
+              warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = "log",
+              **_ignored) -> Callable:
+    """Reference WarmupLR (lr_schedules.py:634): log or linear warmup to max, then hold."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip((step + 1.0) / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            gamma = jnp.log(frac * (math.e - 1.0) + 1.0)
+        else:
+            gamma = frac
+        return jnp.where(step < warmup_num_steps,
+                         warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma,
+                         jnp.asarray(warmup_max_lr, jnp.float32))
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int,
+                    warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001,
+                    warmup_num_steps: int = 1000,
+                    warmup_type: str = "log",
+                    **_ignored) -> Callable:
+    """Reference WarmupDecayLR (lr_schedules.py:723): warmup then linear decay to 0."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay_frac = jnp.clip(
+            (total_num_steps - step) / jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, base(step), warmup_max_lr * decay_frac)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps: int,
+                     warmup_min_ratio: float = 0.01,
+                     warmup_num_steps: int = 1000,
+                     cos_min_ratio: float = 0.0001,
+                     lr: float = 1.0,
+                     **_ignored) -> Callable:
+    """Reference WarmupCosineLR (lr_schedules.py:774): linear warmup from
+    warmup_min_ratio→1, then cosine decay to cos_min_ratio (ratios of base lr)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = warmup_min_ratio + (1.0 - warmup_min_ratio) * jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        progress = jnp.clip((step - warmup_num_steps) / jnp.maximum(float(total_num_steps - warmup_num_steps), 1.0),
+                            0.0, 1.0)
+        cos = cos_min_ratio + (1.0 - cos_min_ratio) * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        return lr * jnp.where(step < warmup_num_steps, warm, cos)
+
+    return schedule
+
+
+_SCHEDULE_BUILDERS = {
+    LR_RANGE_TEST: lr_range_test,
+    ONE_CYCLE: one_cycle,
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+}
+
+
+class LRScheduler:
+    """Imperative wrapper with the torch-style surface the reference exposes
+    (``step()``, ``get_lr()``, ``state_dict()``/``load_state_dict()``)."""
+
+    def __init__(self, schedule_fn: Callable, last_step: int = 0):
+        self.schedule_fn = schedule_fn
+        self.last_step = last_step
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.schedule_fn(self.last_step))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]
+
+
+def build_lr_schedule(sched_type: Optional[str], params: Dict[str, Any], base_lr: float = 1e-3) -> Callable:
+    """Build a pure step->lr function from a scheduler config section.
+
+    Returns a constant schedule at ``base_lr`` when no scheduler is configured
+    (reference behavior: client LR untouched).
+    """
+    if sched_type is None:
+        return lambda step: jnp.asarray(base_lr, jnp.float32)
+    if sched_type not in _SCHEDULE_BUILDERS:
+        raise ValueError(f"unknown scheduler type {sched_type!r}; valid: {VALID_LR_SCHEDULES}")
+    builder = _SCHEDULE_BUILDERS[sched_type]
+    if sched_type == WARMUP_COSINE_LR:
+        params = dict(params)
+        params.setdefault("lr", base_lr)
+    return builder(**params)

@@ -1,0 +1,104 @@
+"""ZeRO sharding rules — the TPU-native core of stages 0-3.
+
+Reference mechanics (runtime/zero/stage_1_and_2.py, stage3.py) exist because
+PyTorch is eager: grad hooks, IPG buckets, flat fp32 partitions, explicit
+allgather of updated shards.  Under XLA/GSPMD the same memory states are
+expressed as sharding annotations on the train-state pytree and the compiler
+inserts the matching collectives:
+
+  stage 0 (DDP):     params/opt replicated over dp; grads psum'd          -> allreduce
+  stage 1:           optimizer state + fp32 master sharded over dp        -> step shards
+                     params stay replicated                               -> allgather of
+                                                                             updated shards
+  stage 2:           + gradients sharded over dp (annotated inside the    -> reduce-scatter
+                     step via with_sharding_constraint)                      instead of
+                                                                             allreduce
+  stage 3 (FSDP):    + compute params sharded over dp; each layer's use   -> per-layer
+                     forces a just-in-time allgather, freed after use        allgather,
+                     (scan-over-layers bounds live memory like the           like the
+                     reference's coordinator's gather/release)               coordinator
+
+The per-leaf rule: shard the largest dimension divisible by the dp shard world
+on the ('data','fsdp') mesh axes; leaves with no divisible dim (scalars, small
+vectors) stay replicated — the analog of the reference's persistence thresholds
+(param_persistence_threshold, zero/config.py:194) under which params are kept
+whole.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, MeshTopology
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """Per-role sharding functions: each maps a pytree (by leaf shape) to a
+    matching tree of NamedShardings."""
+    topo: MeshTopology
+    stage: int
+    shard_axes: Tuple[str, ...]
+    persistence_threshold: int = 0
+
+    def _spec_for_shape(self, shape, sharded: bool) -> PartitionSpec:
+        if not sharded or len(shape) == 0:
+            return PartitionSpec()
+        world = 1
+        for a in self.shard_axes:
+            world *= self.topo.axis_size(a)
+        if world == 1:
+            return PartitionSpec()
+        if int(np.prod(shape)) <= self.persistence_threshold:
+            return PartitionSpec()  # small params stay whole (persistence analog)
+        # largest dim divisible by the shard world
+        candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0]
+        if not candidates:
+            return PartitionSpec()
+        dim = max(candidates, key=lambda t: t[1])[0]
+        spec = [None] * len(shape)
+        spec[dim] = self.shard_axes if len(self.shard_axes) > 1 else self.shard_axes[0]
+        return PartitionSpec(*spec)
+
+    def _tree_shardings(self, tree, sharded: bool):
+        return jax.tree_util.tree_map(
+            lambda leaf: NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded)), tree)
+
+    # -- roles ---------------------------------------------------------------
+    def param_shardings(self, params):
+        """Compute (bit16) params: sharded only at stage 3."""
+        return self._tree_shardings(params, sharded=self.stage >= 3)
+
+    def master_shardings(self, master_params):
+        """FP32 master copy: sharded from stage 1 up."""
+        return self._tree_shardings(master_params, sharded=self.stage >= 1)
+
+    def opt_state_shardings(self, opt_state):
+        """Optimizer moments: sharded from stage 1 up (scalars replicated)."""
+        return self._tree_shardings(opt_state, sharded=self.stage >= 1)
+
+    def grad_shardings(self, grads):
+        """Gradients: sharded from stage 2 up (reduce-scatter instead of allreduce)."""
+        return self._tree_shardings(grads, sharded=self.stage >= 2)
+
+    def constrain_grads(self, grads):
+        """Annotate gradients inside the jitted step so XLA lowers the dp reduction
+        to reduce-scatter (stage>=2) rather than allreduce — the analog of
+        average_tensor's rank-sliced reduce (stage_1_and_2.py:1020)."""
+        if self.stage < 2:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(g), True))), grads)
+
+
+def build_sharding_plan(zero_config, topo: MeshTopology) -> ShardingPlan:
+    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if topo.axis_size(a) > 1) or (DATA_AXIS, )
+    threshold = zero_config.param_persistence_threshold if zero_config.stage >= 3 else 0
+    return ShardingPlan(topo=topo,
+                        stage=zero_config.stage,
+                        shard_axes=axes,
+                        persistence_threshold=threshold)

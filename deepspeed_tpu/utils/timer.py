@@ -1,0 +1,128 @@
+"""Wall-clock and throughput timers.
+
+Analog of deepspeed/utils/timer.py (``SynchronizedWallClockTimer:43``,
+``ThroughputTimer:198``, ``NoopTimer:163``).  The reference synchronizes CUDA
+events; XLA dispatch is async so we synchronize by blocking on a trivial device
+computation before reading the clock.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+
+def _device_sync():
+    try:
+        import jax
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+    except Exception:
+        pass
+
+
+class _Timer:
+
+    def __init__(self, name):
+        self.name = name
+        self.started = False
+        self.start_time = 0.0
+        self.elapsed_ms = 0.0
+        self.count = 0
+
+    def start(self, sync=False):
+        if sync:
+            _device_sync()
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync=False):
+        if not self.started:
+            return
+        if sync:
+            _device_sync()
+        self.elapsed_ms += (time.perf_counter() - self.start_time) * 1000.0
+        self.count += 1
+        self.started = False
+
+    def elapsed(self, reset=True):
+        value = self.elapsed_ms
+        if reset:
+            self.elapsed_ms = 0.0
+            self.count = 0
+        return value
+
+    def mean(self):
+        return self.elapsed_ms / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference utils/timer.py:43)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True):
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) / max(normalizer, 1e-9)
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=[0])
+
+
+class NoopTimer:
+
+    class _N:
+
+        def start(self, *a, **k):
+            pass
+
+        def stop(self, *a, **k):
+            pass
+
+        def elapsed(self, *a, **k):
+            return 0.0
+
+    def __call__(self, name):
+        return self._N()
+
+    def log(self, *a, **k):
+        pass
+
+
+class ThroughputTimer:
+    """Samples/sec tracker (reference utils/timer.py:198)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.step_count = 0
+        self.total_elapsed = 0.0
+        self._t0: Optional[float] = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> Optional[float]:
+        if self._t0 is None:
+            return None
+        _device_sync()
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.step_count += 1
+        if self.step_count > self.start_step:  # skip compile-dominated steps
+            self.total_elapsed += dt
+        return dt
+
+    def avg_samples_per_sec(self) -> float:
+        counted = self.step_count - self.start_step
+        if counted <= 0 or self.total_elapsed == 0:
+            return 0.0
+        return counted * self.batch_size / self.total_elapsed

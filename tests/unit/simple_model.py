@@ -1,0 +1,62 @@
+"""Toy models for tests — analog of tests/unit/simple_model.py (SimpleModel:19,
+random_dataloader helpers): a small MLP expressed as a pure loss function over a
+params pytree."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp_params(key, hidden=16, nlayers=2, out_dim=None):
+    out_dim = out_dim or hidden
+    params = {}
+    keys = jax.random.split(key, nlayers)
+    for i in range(nlayers):
+        od = out_dim if i == nlayers - 1 else hidden
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(keys[i], (hidden, od)) * (1.0 / np.sqrt(hidden)),
+            "b": jnp.zeros((od, )),
+        }
+    return params
+
+
+def mlp_forward(params, x):
+    h = x
+    n = len(params)
+    for i in range(n):
+        p = params[f"layer_{i}"]
+        h = h @ p["w"].astype(h.dtype) + p["b"].astype(h.dtype)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def mlp_loss_fn(params, batch, rng):
+    """MSE regression loss — mirrors SimpleModel's CrossEntropy-ish toy loss."""
+    x, y = batch["x"], batch["y"]
+    pred = mlp_forward(params, x)
+    return jnp.mean((pred - y.astype(pred.dtype))**2).astype(jnp.float32)
+
+
+def random_dataset(n=64, hidden=16, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, hidden)).astype(np.float32)
+    w_true = rng.normal(size=(hidden, hidden)).astype(np.float32) * 0.3
+    ys = xs @ w_true
+    return [{"x": xs[i], "y": ys[i]} for i in range(n)]
+
+
+_W_TRUE = {}
+
+
+def _w_true(hidden):
+    if hidden not in _W_TRUE:
+        _W_TRUE[hidden] = np.random.default_rng(42).normal(size=(hidden, hidden)).astype(np.float32) * 0.3
+    return _W_TRUE[hidden]
+
+
+def random_batch(batch_size, hidden=16, seed=0):
+    """Inputs vary by seed; the ground-truth map is FIXED so training converges."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch_size, hidden)).astype(np.float32)
+    return {"x": x, "y": x @ _w_true(hidden)}

@@ -1,0 +1,67 @@
+"""LR schedule tests — analog of tests/unit/runtime/test_lr_schedulers.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules
+
+
+def test_warmup_lr_reaches_max_and_holds():
+    sched = lr_schedules.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    assert float(sched(0)) == pytest.approx(0.01)
+    assert float(sched(9)) == pytest.approx(0.1)
+    assert float(sched(100)) == pytest.approx(0.1)
+
+
+def test_warmup_log_monotone():
+    sched = lr_schedules.warmup_lr(warmup_max_lr=0.1, warmup_num_steps=50, warmup_type="log")
+    vals = [float(sched(s)) for s in range(60)]
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+    assert vals[55] == pytest.approx(0.1)
+
+
+def test_warmup_decay_hits_zero():
+    sched = lr_schedules.warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    assert float(sched(100)) == pytest.approx(0.0, abs=1e-8)
+    assert float(sched(55)) == pytest.approx(0.1 * (45 / 90), rel=1e-5)
+
+
+def test_warmup_cosine():
+    sched = lr_schedules.warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10, warmup_min_ratio=0.0,
+                                          cos_min_ratio=0.0, lr=1.0)
+    assert float(sched(10)) == pytest.approx(1.0, rel=1e-3)
+    assert float(sched(55)) == pytest.approx(0.5, rel=1e-2)
+    assert float(sched(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_one_cycle_shape():
+    sched = lr_schedules.one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=10)
+    assert float(sched(0)) == pytest.approx(0.01)
+    assert float(sched(10)) == pytest.approx(0.1)
+    assert float(sched(20)) == pytest.approx(0.01)
+
+
+def test_lr_range_test():
+    sched = lr_schedules.lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=5,
+                                       lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    assert float(sched(0)) == pytest.approx(0.001)
+    assert float(sched(5)) == pytest.approx(0.002)
+
+
+def test_build_from_config():
+    fn = lr_schedules.build_lr_schedule("WarmupLR", {"warmup_max_lr": 0.01, "warmup_num_steps": 5})
+    assert float(fn(10)) == pytest.approx(0.01)
+    const = lr_schedules.build_lr_schedule(None, {}, base_lr=3e-4)
+    assert float(const(1234)) == pytest.approx(3e-4)
+    with pytest.raises(ValueError):
+        lr_schedules.build_lr_schedule("NopeLR", {})
+
+
+def test_scheduler_object_state_dict():
+    fn = lr_schedules.build_lr_schedule("WarmupLR", {"warmup_max_lr": 0.01, "warmup_num_steps": 5})
+    sched = lr_schedules.LRScheduler(fn)
+    sched.step()
+    sd = sched.state_dict()
+    sched2 = lr_schedules.LRScheduler(fn)
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr() == sched.get_lr()

@@ -1,0 +1,136 @@
+"""Optimizer tests — analog of tests/unit/ops/adam/ (FusedAdam vs torch.Adam
+parity) and runtime/half_precision loss-scaler tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import optimizers
+from deepspeed_tpu.runtime.config import FP16Config
+from deepspeed_tpu.runtime.optimizers import (clip_by_global_norm, global_grad_norm, has_overflow, init_loss_scale,
+                                              update_loss_scale)
+
+
+def _run_ours(opt, params, grads_seq, lr):
+    state = opt.init(params)
+    for g in grads_seq:
+        updates, state = opt.update(g, state, params, lr)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+    return params
+
+
+def _torch_params(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_adamw_matches_torch(wd):
+    import torch
+    w0 = _torch_params((8, 4))
+    grads_seq = [{"w": jnp.asarray(_torch_params((8, 4), seed=i + 1))} for i in range(5)]
+
+    ours = _run_ours(optimizers.adam(weight_decay=wd, adam_w_mode=True), {"w": jnp.asarray(w0)}, grads_seq, 1e-2)
+
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.AdamW([tp], lr=1e-2, weight_decay=wd, eps=1e-8)
+    for g in grads_seq:
+        tp.grad = torch.tensor(np.asarray(g["w"]))
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ours["w"]), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adam_l2_mode_matches_torch():
+    import torch
+    w0 = _torch_params((6, 3))
+    grads_seq = [{"w": jnp.asarray(_torch_params((6, 3), seed=i + 1))} for i in range(4)]
+    ours = _run_ours(optimizers.adam(weight_decay=0.01, adam_w_mode=False), {"w": jnp.asarray(w0)}, grads_seq, 1e-2)
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tp], lr=1e-2, weight_decay=0.01, eps=1e-8)
+    for g in grads_seq:
+        tp.grad = torch.tensor(np.asarray(g["w"]))
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ours["w"]), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_torch():
+    import torch
+    w0 = _torch_params((5, 5))
+    grads_seq = [{"w": jnp.asarray(_torch_params((5, 5), seed=i + 7))} for i in range(4)]
+    ours = _run_ours(optimizers.sgd(momentum=0.9), {"w": jnp.asarray(w0)}, grads_seq, 1e-2)
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.SGD([tp], lr=1e-2, momentum=0.9)
+    for g in grads_seq:
+        tp.grad = torch.tensor(np.asarray(g["w"]))
+        topt.step()
+    np.testing.assert_allclose(np.asarray(ours["w"]), tp.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_lion_update_direction():
+    params = {"w": jnp.ones((4, 4))}
+    opt = optimizers.lion()
+    state = opt.init(params)
+    grads = {"w": jnp.full((4, 4), 0.5)}
+    updates, state = opt.update(grads, state, params, lr=0.1)
+    np.testing.assert_allclose(np.asarray(updates["w"]), np.full((4, 4), -0.1), rtol=1e-6)
+
+
+def test_lamb_trust_ratio_bounds():
+    params = {"w": jnp.ones((4, 4)) * 100.0}
+    opt = optimizers.lamb(max_coeff=10.0, min_coeff=0.01)
+    state = opt.init(params)
+    grads = {"w": jnp.full((4, 4), 1e-8)}
+    updates, _ = opt.update(grads, state, params, lr=0.1)
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+def test_adagrad_accumulates():
+    params = {"w": jnp.ones((3, ))}
+    opt = optimizers.adagrad()
+    state = opt.init(params)
+    g = {"w": jnp.ones((3, ))}
+    u1, state = opt.update(g, state, params, lr=1.0)
+    u2, state = opt.update(g, state, params, lr=1.0)
+    assert abs(float(u1["w"][0])) > abs(float(u2["w"][0]))  # effective lr decays
+
+
+def test_get_optimizer_registry():
+    for name in ["adam", "adamw", "fusedadam", "sgd", "lion", "adagrad", "lamb"]:
+        opt = optimizers.get_optimizer(name, lr=1e-3)
+        assert opt.init is not None
+    with pytest.raises(ValueError):
+        optimizers.get_optimizer("rmsprop_nope")
+
+
+def test_grad_norm_and_clip():
+    grads = {"a": jnp.full((3, ), 2.0), "b": jnp.full((4, ), 2.0)}
+    norm = float(global_grad_norm(grads))
+    np.testing.assert_allclose(norm, np.sqrt(7 * 4.0), rtol=1e-6)
+    clipped, _ = clip_by_global_norm(grads, 1.0)
+    np.testing.assert_allclose(float(global_grad_norm(clipped)), 1.0, rtol=1e-4)
+
+
+def test_has_overflow():
+    assert not bool(has_overflow({"a": jnp.ones(3)}))
+    assert bool(has_overflow({"a": jnp.array([1.0, np.inf])}))
+    assert bool(has_overflow({"a": jnp.array([np.nan])}))
+
+
+def test_dynamic_loss_scale_schedule():
+    cfg = FP16Config(enabled=True, initial_scale_power=4, loss_scale_window=2, hysteresis=1, min_loss_scale=1.0)
+    s = init_loss_scale(cfg)
+    assert float(s.cur_scale) == 16.0
+    s = update_loss_scale(s, jnp.asarray(True), cfg)  # overflow -> halve
+    assert float(s.cur_scale) == 8.0
+    s = update_loss_scale(s, jnp.asarray(False), cfg)
+    s = update_loss_scale(s, jnp.asarray(False), cfg)  # window hit -> double
+    assert float(s.cur_scale) == 16.0
+
+
+def test_static_loss_scale():
+    cfg = FP16Config(enabled=True, loss_scale=128.0)
+    s = init_loss_scale(cfg)
+    assert float(s.cur_scale) == 128.0
+    s = update_loss_scale(s, jnp.asarray(True), cfg)
+    assert float(s.cur_scale) == 128.0  # static never changes
