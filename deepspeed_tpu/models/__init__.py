@@ -1,0 +1,4 @@
+from . import bert, gpt2, llama, transformer
+from .bert import BertConfig
+from .gpt2 import GPT2Config
+from .llama import LlamaConfig

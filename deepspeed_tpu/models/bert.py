@@ -1,0 +1,111 @@
+"""BERT-style bidirectional encoder with MLM loss.
+
+Parity: the reference's config ladder step 1 (bert-base + ZeRO-1, BASELINE.md)
+and the fused-transformer training kernels' target workload
+(csrc/transformer/ — BERT-style layers, tests/unit/ops/transformer/).
+"""
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import cross_entropy_loss, gelu_mlp, init_linear, layer_norm, sdpa
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    ln_eps: float = 1e-12
+    remat: bool = True
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, seq=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers, num_heads=heads, max_seq_len=seq)
+
+
+def init_params(config: BertConfig, key, dtype=jnp.float32):
+    L, D, V = config.num_layers, config.hidden_size, config.vocab_size
+    keys = jax.random.split(key, 8)
+
+    def stack(key, in_dim, out_dim):
+        ks = jax.random.split(key, L)
+        return jnp.stack([init_linear(k, in_dim, out_dim, dtype=dtype) for k in ks])
+
+    return {
+        "tok_emb": jax.random.normal(keys[0], (V, D), dtype) * 0.02,
+        "pos_emb": jax.random.normal(keys[1], (config.max_seq_len, D), dtype) * 0.02,
+        "type_emb": jax.random.normal(keys[6], (config.type_vocab_size, D), dtype) * 0.02,
+        "emb_ln_w": jnp.ones((D, ), dtype),
+        "emb_ln_b": jnp.zeros((D, ), dtype),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dtype), "ln1_b": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), dtype), "ln2_b": jnp.zeros((L, D), dtype),
+            "attn": {
+                "w_qkv": stack(keys[2], D, 3 * D),
+                "b_qkv": jnp.zeros((L, 3 * D), dtype),
+                "w_proj": stack(keys[3], D, D),
+                "b_proj": jnp.zeros((L, D), dtype),
+            },
+            "mlp": {
+                "w_fc1": stack(keys[4], D, 4 * D),
+                "b_fc1": jnp.zeros((L, 4 * D), dtype),
+                "w_fc2": stack(keys[5], 4 * D, D),
+                "b_fc2": jnp.zeros((L, D), dtype),
+            },
+        },
+        "mlm_head": init_linear(keys[7], D, V, dtype=dtype),
+    }
+
+
+def forward(config: BertConfig, params, input_ids, token_type_ids=None, attention_mask=None, attention_fn=None):
+    b, s = input_ids.shape
+    x = params["tok_emb"][input_ids] + params["pos_emb"][:s][None]
+    if token_type_ids is not None:
+        x = x + params["type_emb"][token_type_ids]
+    x = layer_norm(x, params["emb_ln_w"], params["emb_ln_b"], config.ln_eps)
+    H = config.num_heads
+    attn_fn = attention_fn or sdpa
+    mask = None
+    if attention_mask is not None:
+        mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S] broadcast over heads/query
+
+    def layer(x, lp):
+        # post-LN BERT: attn -> add&norm -> mlp -> add&norm
+        qkv = x @ lp["attn"]["w_qkv"].astype(x.dtype) + lp["attn"]["b_qkv"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        d = q.shape[-1] // H
+        att = attn_fn(q.reshape(b, s, H, d), k.reshape(b, s, H, d), v.reshape(b, s, H, d),
+                      causal=False, mask=mask).reshape(b, s, H * d)
+        x = layer_norm(x + att @ lp["attn"]["w_proj"].astype(x.dtype) + lp["attn"]["b_proj"].astype(x.dtype),
+                       lp["ln1_w"], lp["ln1_b"], config.ln_eps)
+        x = layer_norm(x + gelu_mlp(lp["mlp"], x), lp["ln2_w"], lp["ln2_b"], config.ln_eps)
+        return x, None
+
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x @ params["mlm_head"].astype(x.dtype)
+
+
+def make_loss_fn(config: BertConfig, attention_fn=None) -> Callable:
+    """MLM loss; batch: {input_ids, labels[, token_type_ids, attention_mask]}."""
+
+    def loss_fn(params, batch, rng):
+        logits = forward(config, params, batch["input_ids"],
+                         token_type_ids=batch.get("token_type_ids"),
+                         attention_mask=batch.get("attention_mask"),
+                         attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn

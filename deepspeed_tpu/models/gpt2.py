@@ -1,0 +1,101 @@
+"""GPT-2 style causal LM (learned positions, LayerNorm, GeLU MLP).
+
+Parity: the reference's config ladder step 2 (GPT2-350M + ZeRO-2 + FusedAdam,
+BASELINE.md) and module_inject's gpt2 policies.
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import cross_entropy_loss, gelu_mlp, init_linear, layer_norm, sdpa
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 1024
+    ln_eps: float = 1e-5
+    remat: bool = True
+
+    @staticmethod
+    def gpt2_350m():
+        return GPT2Config()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, seq=64):
+        return GPT2Config(vocab_size=vocab, hidden_size=hidden, num_layers=layers, num_heads=heads, max_seq_len=seq)
+
+
+def init_params(config: GPT2Config, key, dtype=jnp.float32):
+    L, D, V = config.num_layers, config.hidden_size, config.vocab_size
+    keys = jax.random.split(key, 8)
+
+    def stack(key, in_dim, out_dim):
+        ks = jax.random.split(key, L)
+        return jnp.stack([init_linear(k, in_dim, out_dim, dtype=dtype) for k in ks])
+
+    return {
+        "wte": jax.random.normal(keys[0], (V, D), dtype) * 0.02,
+        "wpe": jax.random.normal(keys[1], (config.max_seq_len, D), dtype) * 0.01,
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dtype), "ln1_b": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), dtype), "ln2_b": jnp.zeros((L, D), dtype),
+            "attn": {
+                "w_qkv": stack(keys[2], D, 3 * D),
+                "b_qkv": jnp.zeros((L, 3 * D), dtype),
+                "w_proj": stack(keys[3], D, D),
+                "b_proj": jnp.zeros((L, D), dtype),
+            },
+            "mlp": {
+                "w_fc1": stack(keys[4], D, 4 * D),
+                "b_fc1": jnp.zeros((L, 4 * D), dtype),
+                "w_fc2": stack(keys[5], 4 * D, D),
+                "b_fc2": jnp.zeros((L, D), dtype),
+            },
+        },
+        "lnf_w": jnp.ones((D, ), dtype),
+        "lnf_b": jnp.zeros((D, ), dtype),
+    }
+
+
+def forward(config: GPT2Config, params, input_ids, attention_fn=None):
+    b, s = input_ids.shape
+    x = params["wte"][input_ids] + params["wpe"][:s][None]
+    H = config.num_heads
+    attn_fn = attention_fn or sdpa
+
+    def layer(x, lp):
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], config.ln_eps)
+        qkv = h @ lp["attn"]["w_qkv"].astype(h.dtype) + lp["attn"]["b_qkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        d = q.shape[-1] // H
+        q = q.reshape(b, s, H, d)
+        k = k.reshape(b, s, H, d)
+        v = v.reshape(b, s, H, d)
+        att = attn_fn(q, k, v, causal=True).reshape(b, s, H * d)
+        x = x + att @ lp["attn"]["w_proj"].astype(h.dtype) + lp["attn"]["b_proj"].astype(h.dtype)
+        h2 = layer_norm(x, lp["ln2_w"], lp["ln2_b"], config.ln_eps)
+        x = x + gelu_mlp(lp["mlp"], h2)
+        return x, None
+
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = layer_norm(x, params["lnf_w"], params["lnf_b"], config.ln_eps)
+    return x @ params["wte"].T.astype(x.dtype)  # tied head
+
+
+def make_loss_fn(config: GPT2Config, attention_fn=None) -> Callable:
+
+    def loss_fn(params, batch, rng):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn

@@ -1,0 +1,146 @@
+"""Llama-family causal LM — the flagship training model.
+
+Parity target: the reference trains Llama-2 via HF + ZeRO-3 (BASELINE.md config
+ladder) and serves it via inference/v2/model_implementations/llama_v2.  This is
+a TPU-first implementation: stacked-layer params swept by ``lax.scan`` (one
+compiled block; per-layer ZeRO-3 gather), per-layer ``jax.checkpoint`` remat,
+bf16 compute with fp32 reductions, rotary + GQA attention.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (apply_rotary, attention_block, cross_entropy_loss, init_linear, rms_norm, rotary_tables,
+                          sdpa, swiglu_mlp)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    remat: bool = True
+    remat_policy: Optional[str] = "nothing_saveable"
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2, seq=64):
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+                           num_layers=layers, num_heads=heads, num_kv_heads=kv_heads, max_seq_len=seq)
+
+
+def init_params(config: LlamaConfig, key, dtype=jnp.float32):
+    """Params pytree: per-layer leaves STACKED on dim 0 (num_layers) for scan."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    L, D, F = config.num_layers, config.hidden_size, config.intermediate_size
+    H, KV = config.num_heads, config.num_kv_heads
+    head_dim = D // H
+    lk = jax.random.split(k_layers, 7)
+
+    def stack(key, in_dim, out_dim):
+        keys = jax.random.split(key, L)
+        return jnp.stack([init_linear(k, in_dim, out_dim, dtype=dtype) for k in keys])
+
+    params = {
+        "embed": jax.random.normal(k_emb, (config.vocab_size, D), dtype) * 0.02,
+        "layers": {
+            "attn": {
+                "wq": stack(lk[0], D, H * head_dim),
+                "wk": stack(lk[1], D, KV * head_dim),
+                "wv": stack(lk[2], D, KV * head_dim),
+                "wo": stack(lk[3], H * head_dim, D),
+            },
+            "mlp": {
+                "w_gate": stack(lk[4], D, F),
+                "w_up": stack(lk[5], D, F),
+                "w_down": stack(lk[6], F, D),
+            },
+            "attn_norm": jnp.ones((L, D), dtype),
+            "mlp_norm": jnp.ones((L, D), dtype),
+        },
+        "final_norm": jnp.ones((D, ), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = init_linear(k_out, D, config.vocab_size, dtype=dtype)
+    return params
+
+
+def _layer_fn(config: LlamaConfig, cos, sin, attention_fn=None):
+
+    def layer(x, layer_params):
+        attn_in = rms_norm(x, layer_params["attn_norm"], config.rms_eps)
+        attn_out, _ = attention_block(layer_params["attn"], attn_in,
+                                      n_heads=config.num_heads, n_kv_heads=config.num_kv_heads,
+                                      cos=cos, sin=sin, causal=True, attention_fn=attention_fn)
+        x = x + attn_out
+        mlp_in = rms_norm(x, layer_params["mlp_norm"], config.rms_eps)
+        x = x + swiglu_mlp(layer_params["mlp"], mlp_in)
+        return x, None
+
+    return layer
+
+
+def forward(config: LlamaConfig, params, input_ids, attention_fn=None):
+    """input_ids [B, S] -> logits [B, S, V]."""
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
+    x = params["embed"][input_ids]  # keep embed dtype (engine casts params)
+    layer = _layer_fn(config, cos, sin, attention_fn)
+    if config.remat:
+        policy = getattr(jax.checkpoint_policies, config.remat_policy, None) if config.remat_policy else None
+        layer = jax.checkpoint(layer, policy=policy)
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return x @ head.astype(x.dtype)
+
+
+def make_loss_fn(config: LlamaConfig, attention_fn=None) -> Callable:
+    """loss_fn(params, batch, rng) for the engine; batch: {input_ids, labels}
+    (labels = input_ids shifted; -100 = ignore)."""
+
+    def loss_fn(params, batch, rng):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn
+
+
+def causal_lm_batch(input_ids: np.ndarray):
+    """Build {input_ids, labels} with next-token labels from raw token rows."""
+    labels = np.full_like(input_ids, -100)
+    labels[:, :-1] = input_ids[:, 1:]
+    return {"input_ids": input_ids, "labels": labels}
+
+
+def num_params(config: LlamaConfig) -> int:
+    D, F, L, V = config.hidden_size, config.intermediate_size, config.num_layers, config.vocab_size
+    H, KV = config.num_heads, config.num_kv_heads
+    head_dim = D // H
+    per_layer = (D * (H * head_dim) + 2 * D * (KV * head_dim) + (H * head_dim) * D
+                 + D * F * 2 + F * D + 2 * D)
+    total = V * D + L * per_layer + D
+    if not config.tie_embeddings:
+        total += D * V
+    return total
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token (6N + attention terms) for MFU accounting."""
+    n = num_params(config)
+    attn = 12 * config.num_layers * config.hidden_size * seq_len  # qk+av fwd+bwd
+    return 6.0 * n + attn
