@@ -25,6 +25,7 @@ def initialize(args=None,
                topology: Optional[MeshTopology] = None,
                dist_init_required: Optional[bool] = None,
                collate_fn=None,
+               tp_rules=None,
                **kwargs):
     """Build a training engine (reference deepspeed.initialize, __init__.py:64).
 
@@ -56,7 +57,9 @@ def initialize(args=None,
     if model_parameters is None:
         raise ValueError("initialize() needs model_parameters (the params pytree)")
 
-    engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology)
+    if tp_rules is None and model is not None:
+        tp_rules = getattr(model, "tp_rules", None)
+    engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules)
 
     dataloader = None
     if training_data is not None:

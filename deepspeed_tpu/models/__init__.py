@@ -2,3 +2,5 @@ from . import bert, gpt2, llama, transformer
 from .bert import BertConfig
 from .gpt2 import GPT2Config
 from .llama import LlamaConfig
+from . import mixtral
+from .mixtral import MixtralConfig

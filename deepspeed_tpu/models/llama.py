@@ -127,6 +127,24 @@ def causal_lm_batch(input_ids: np.ndarray):
     return {"input_ids": input_ids, "labels": labels}
 
 
+def tp_rules(path: str, shape) -> "int | None":
+    """Tensor-parallel sharding rules — the Megatron-style column/row-parallel
+    layout the reference receives via the external mpu (deepspeed/__init__.py:95)
+    and that AutoTP autodetects for inference (module_inject/auto_tp.py:188).
+
+    Column-parallel (shard output dim): wq/wk/wv, w_gate/w_up, lm_head.
+    Row-parallel (shard input dim): wo, w_down.  Stacked layer leaves carry a
+    leading L dim, so dims shift by one.
+    """
+    if path.endswith(("attn.wq", "attn.wk", "attn.wv", "mlp.w_gate", "mlp.w_up")):
+        return 2  # [L, in, out] -> shard out
+    if path.endswith(("attn.wo", "mlp.w_down")):
+        return 1  # [L, in, out] -> shard in
+    if path == "lm_head":
+        return 1  # [D, V] -> vocab-parallel logits
+    return None
+
+
 def num_params(config: LlamaConfig) -> int:
     D, F, L, V = config.hidden_size, config.intermediate_size, config.num_layers, config.vocab_size
     H, KV = config.num_heads, config.num_kv_heads

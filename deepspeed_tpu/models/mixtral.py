@@ -1,0 +1,126 @@
+"""Mixtral-style MoE causal LM.
+
+Parity: the reference serves mixtral via inference/v2/model_implementations/
+mixtral and trains MoE via deepspeed/moe; BASELINE.md config ladder step 5 is
+Mixtral-8x7B EP+Ulysses SP.  Llama backbone with the FFN replaced by a top-k
+gated expert layer; aux losses summed across layers and added to the LM loss
+(reference MoE aux-loss pattern, sharded_moe.py top2gating usage).
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..moe.experts import init_swiglu_experts, swiglu_experts
+from ..moe.sharded_moe import TopKGate, moe_layer
+from ..parallel.mesh import EXPERT_AXIS
+from .transformer import attention_block, cross_entropy_loss, init_linear, rms_norm, rotary_tables
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_coef: float = 0.02
+    max_seq_len: int = 4096
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-5
+    remat: bool = True
+
+    @staticmethod
+    def mixtral_8x7b():
+        return MixtralConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2, experts=4, seq=64):
+        return MixtralConfig(vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+                             num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
+                             num_experts=experts, max_seq_len=seq)
+
+
+def init_params(config: MixtralConfig, key, dtype=jnp.float32):
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    L, D = config.num_layers, config.hidden_size
+    H, KV = config.num_heads, config.num_kv_heads
+    head_dim = D // H
+    lk = jax.random.split(k_layers, 6)
+
+    def stack(key, in_dim, out_dim):
+        keys = jax.random.split(key, L)
+        return jnp.stack([init_linear(k, in_dim, out_dim, dtype=dtype) for k in keys])
+
+    def stack_experts(key):
+        keys = jax.random.split(key, L)
+        per_layer = [init_swiglu_experts(k, config.num_experts, D, config.intermediate_size, dtype=dtype)
+                     for k in keys]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
+
+    gate_keys = jax.random.split(lk[4], L)
+    return {
+        "embed": jax.random.normal(k_emb, (config.vocab_size, D), dtype) * 0.02,
+        "layers": {
+            "attn": {
+                "wq": stack(lk[0], D, H * head_dim),
+                "wk": stack(lk[1], D, KV * head_dim),
+                "wv": stack(lk[2], D, KV * head_dim),
+                "wo": stack(lk[3], H * head_dim, D),
+            },
+            "moe": {
+                "gate": {"wg": jnp.stack([jax.random.normal(k, (D, config.num_experts), dtype) * 0.02
+                                          for k in gate_keys])},
+                "experts": stack_experts(lk[5]),
+            },
+            "attn_norm": jnp.ones((L, D), dtype),
+            "mlp_norm": jnp.ones((L, D), dtype),
+        },
+        "final_norm": jnp.ones((D, ), dtype),
+        "lm_head": init_linear(k_out, D, config.vocab_size, dtype=dtype),
+    }
+
+
+def forward(config: MixtralConfig, params, input_ids, attention_fn=None, train=True, topo=None):
+    """-> (logits, total_aux_loss)."""
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
+    x = params["embed"][input_ids]
+    gate = TopKGate(config.hidden_size, config.num_experts, k=config.top_k,
+                    capacity_factor=config.capacity_factor,
+                    eval_capacity_factor=config.capacity_factor)
+
+    def layer(carry, layer_params):
+        x, aux = carry
+        attn_in = rms_norm(x, layer_params["attn_norm"], config.rms_eps)
+        attn_out, _ = attention_block(layer_params["attn"], attn_in,
+                                      n_heads=config.num_heads, n_kv_heads=config.num_kv_heads,
+                                      cos=cos, sin=sin, causal=True, attention_fn=attention_fn)
+        x = x + attn_out
+        moe_in = rms_norm(x, layer_params["mlp_norm"], config.rms_eps)
+        moe_out, l_aux = moe_layer(gate, layer_params["moe"], moe_in,
+                                   expert_fn=swiglu_experts, train=train, topo=topo)
+        return (x + moe_out, aux + l_aux), None
+
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    (x, aux), _ = jax.lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    logits = x @ params["lm_head"].astype(x.dtype)
+    return logits, aux
+
+
+def make_loss_fn(config: MixtralConfig, attention_fn=None, topo=None) -> Callable:
+
+    def loss_fn(params, batch, rng):
+        logits, aux = forward(config, params, batch["input_ids"], attention_fn=attention_fn, topo=topo)
+        lm = cross_entropy_loss(logits, batch["labels"])
+        return lm + config.aux_loss_coef * aux, {"aux_loss": aux}
+
+    return loss_fn

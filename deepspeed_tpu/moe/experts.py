@@ -1,0 +1,56 @@
+"""Grouped expert FFNs.
+
+Analog of deepspeed/moe/experts.py — but instead of a ModuleList of per-expert
+FFNs looped over, expert weights are STACKED on a leading E dim and applied as
+one batched einsum (grouped GEMM on the MXU; the pattern the reference's v2
+inference gets from CUTLASS moe_gemm, inference/v2/kernels/cutlass_ops/moe_gemm).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import init_linear
+
+
+def init_swiglu_experts(key, num_experts: int, model_dim: int, hidden_dim: int, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+
+    def stack(k, i, o):
+        kk = jax.random.split(k, num_experts)
+        return jnp.stack([init_linear(q, i, o, dtype=dtype) for q in kk])
+
+    return {
+        "w_gate": stack(ks[0], model_dim, hidden_dim),
+        "w_up": stack(ks[1], model_dim, hidden_dim),
+        "w_down": stack(ks[2], hidden_dim, model_dim),
+    }
+
+
+def swiglu_experts(params, tokens):
+    """tokens [E, C, M] -> [E, C, M], vectorized over experts."""
+    gate = jax.nn.silu(jnp.einsum("ecm,emh->ech", tokens, params["w_gate"].astype(tokens.dtype)))
+    up = jnp.einsum("ecm,emh->ech", tokens, params["w_up"].astype(tokens.dtype))
+    return jnp.einsum("ech,ehm->ecm", gate * up, params["w_down"].astype(tokens.dtype))
+
+
+def init_gelu_experts(key, num_experts: int, model_dim: int, hidden_dim: int, dtype=jnp.float32):
+    ks = jax.random.split(key, 2)
+
+    def stack(k, i, o):
+        kk = jax.random.split(k, num_experts)
+        return jnp.stack([init_linear(q, i, o, dtype=dtype) for q in kk])
+
+    return {
+        "w_fc1": stack(ks[0], model_dim, hidden_dim),
+        "b_fc1": jnp.zeros((num_experts, hidden_dim), dtype),
+        "w_fc2": stack(ks[1], hidden_dim, model_dim),
+        "b_fc2": jnp.zeros((num_experts, model_dim), dtype),
+    }
+
+
+def gelu_experts(params, tokens):
+    h = jnp.einsum("ecm,emh->ech", tokens, params["w_fc1"].astype(tokens.dtype)) + \
+        params["b_fc1"][:, None, :].astype(tokens.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("ech,ehm->ecm", h, params["w_fc2"].astype(tokens.dtype)) + \
+        params["b_fc2"][:, None, :].astype(tokens.dtype)

@@ -1,0 +1,64 @@
+"""MoE facade.
+
+Analog of deepspeed/moe/layer.py (``MoE:16``): bundles a TopKGate + grouped
+experts into one layer with an init/apply pair, exposing the reference's
+constructor surface (num_experts, k, capacity factors, noisy gating, ep_size).
+
+``ep_size`` maps to the mesh's 'expert' axis: the reference builds
+expert-parallel process groups (_create_expert_and_data_parallel, groups.py:113);
+here the expert dim of the stacked weights is sharded over that axis and XLA
+derives the dispatch all-to-all.
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import EXPERT_AXIS, MeshTopology
+from . import experts as experts_lib
+from .sharded_moe import TopKGate, moe_layer
+
+
+class MoE:
+
+    def __init__(self,
+                 hidden_size: int,
+                 expert_intermediate_size: Optional[int] = None,
+                 num_experts: int = 1,
+                 ep_size: int = 1,
+                 k: int = 1,
+                 capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True,
+                 expert_kind: str = "swiglu"):
+        if num_experts % ep_size != 0:
+            raise ValueError(f"num_experts({num_experts}) must be divisible by ep_size({ep_size}) "
+                             "(reference moe/layer.py:16 assertion)")
+        self.hidden_size = hidden_size
+        self.ffn_dim = expert_intermediate_size or 4 * hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.expert_kind = expert_kind
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor, eval_capacity_factor,
+                             min_capacity, noisy_gate_policy, drop_tokens)
+        if expert_kind == "swiglu":
+            self._init_experts = experts_lib.init_swiglu_experts
+            self._expert_fn = experts_lib.swiglu_experts
+        else:
+            self._init_experts = experts_lib.init_gelu_experts
+            self._expert_fn = experts_lib.gelu_experts
+
+    def init(self, key, dtype=jnp.float32):
+        k_gate, k_exp = jax.random.split(key)
+        return {
+            "gate": self.gate.init(k_gate, dtype=dtype),
+            "experts": self._init_experts(k_exp, self.num_experts, self.hidden_size, self.ffn_dim, dtype=dtype),
+        }
+
+    def __call__(self, params, x, train: bool = True, rng=None, topo: Optional[MeshTopology] = None):
+        """x [..., hidden] -> (out, l_aux)."""
+        return moe_layer(self.gate, params, x, expert_fn=self._expert_fn, train=train, rng=rng,
+                         ep_axis=EXPERT_AXIS, topo=topo)

@@ -64,7 +64,8 @@ class Engine:
                  params: Any,
                  config: TrainingConfig,
                  topology: Optional[MeshTopology] = None,
-                 dp_world_size: Optional[int] = None):
+                 dp_world_size: Optional[int] = None,
+                 tp_rules=None):
         self.config = config
         self.loss_fn = loss_fn
         self.topology = topology or MeshTopology.build(config.mesh)
@@ -74,7 +75,7 @@ class Engine:
          self.gradient_accumulation_steps) = config.resolve_batch_sizes(self.dp_world_size)
 
         self.zero_stage = config.zero_optimization.stage
-        self.plan: ShardingPlan = build_sharding_plan(config.zero_optimization, self.topology)
+        self.plan: ShardingPlan = build_sharding_plan(config.zero_optimization, self.topology, tp_rules=tp_rules)
 
         # optimizer
         opt_cfg = config.optimizer
@@ -149,15 +150,17 @@ class Engine:
         fp16 = self.fp16_enabled
         fp16_cfg = self.config.fp16
         clip_norm = self.config.gradient_clipping
-        rep_spec = None
-        if self.zero_stage in (1, 2):
-            rep_spec = NamedSharding(self.topology.mesh, PartitionSpec())
+        compute_shardings = None
+        if self.zero_stage < 3:
+            # Replicated over dp (keeping any tensor-parallel dims sharded): the
+            # bit16-allgather analog.  Stage 3 leaves layout to GSPMD so gathers
+            # happen per-layer inside the scan, not up front.
+            compute_shardings = self.plan.param_shardings(self.state.params)
 
         def cast_for_compute(master):
             p16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), master)
-            if rep_spec is not None:
-                # gather the HALF-precision copy (bit16 allgather analog)
-                p16 = jax.tree_util.tree_map(lambda x: jax.lax.with_sharding_constraint(x, rep_spec), p16)
+            if compute_shardings is not None:
+                p16 = jax.tree_util.tree_map(jax.lax.with_sharding_constraint, p16, compute_shardings)
             return p16
 
         def train_step(state: TrainState, batch) -> Tuple[TrainState, StepMetrics]:

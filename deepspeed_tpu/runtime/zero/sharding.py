@@ -34,38 +34,77 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, MeshTopology
 
+# A tp rule maps (dotted param path, shape) -> dim index to shard over the
+# 'tensor' axis, or None.  Models export one (e.g. models.llama.tp_rules) — the
+# built-in analog of Megatron's mpu column/row-parallel layout that the
+# reference consumes externally (deepspeed/__init__.py:95 mpu contract) and
+# AutoTP infers for inference (module_inject/auto_tp.py:188).
+TpRuleFn = Callable[[str, Tuple[int, ...]], Optional[int]]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
 
 @dataclasses.dataclass(frozen=True)
 class ShardingPlan:
-    """Per-role sharding functions: each maps a pytree (by leaf shape) to a
-    matching tree of NamedShardings."""
+    """Per-role sharding functions: each maps a pytree (by leaf shape + path) to
+    a matching tree of NamedShardings, merging ZeRO dp/fsdp sharding with
+    tensor-parallel rules."""
     topo: MeshTopology
     stage: int
     shard_axes: Tuple[str, ...]
     persistence_threshold: int = 0
+    tp_rules: Optional[TpRuleFn] = None
 
-    def _spec_for_shape(self, shape, sharded: bool) -> PartitionSpec:
-        if not sharded or len(shape) == 0:
+    def _spec_for_shape(self, shape, sharded: bool, path: str = "") -> PartitionSpec:
+        if len(shape) == 0:
             return PartitionSpec()
+        spec = [None] * len(shape)
+        tp_dim = None
+        if self.tp_rules is not None and self.topo.axis_size(TENSOR_AXIS) > 1:
+            tp_dim = self.tp_rules(path, tuple(shape))
+            if tp_dim is not None:
+                if shape[tp_dim] % self.topo.axis_size(TENSOR_AXIS) != 0:
+                    tp_dim = None
+                else:
+                    spec[tp_dim] = TENSOR_AXIS
+        if not sharded:
+            return PartitionSpec(*spec)
         world = 1
         for a in self.shard_axes:
             world *= self.topo.axis_size(a)
-        if world == 1:
-            return PartitionSpec()
-        if int(np.prod(shape)) <= self.persistence_threshold:
-            return PartitionSpec()  # small params stay whole (persistence analog)
-        # largest dim divisible by the shard world
-        candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0]
-        if not candidates:
-            return PartitionSpec()
-        dim = max(candidates, key=lambda t: t[1])[0]
-        spec = [None] * len(shape)
-        spec[dim] = self.shard_axes if len(self.shard_axes) > 1 else self.shard_axes[0]
+        if world == 1 or int(np.prod(shape)) <= self.persistence_threshold:
+            return PartitionSpec(*spec)
+        zero_axes = self.shard_axes if len(self.shard_axes) > 1 else self.shard_axes[0]
+        # largest dim divisible by the shard world, excluding the tp dim;
+        # fall back to stacking zero axes onto the tp dim if it alone divides
+        candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0 and d != tp_dim]
+        if candidates:
+            dim = max(candidates, key=lambda t: t[1])[0]
+            spec[dim] = zero_axes
+        elif tp_dim is not None and shape[tp_dim] % (world * self.topo.axis_size(TENSOR_AXIS)) == 0:
+            za = self.shard_axes if len(self.shard_axes) > 1 else (self.shard_axes[0], )
+            spec[tp_dim] = (TENSOR_AXIS, *za)
         return PartitionSpec(*spec)
 
     def _tree_shardings(self, tree, sharded: bool):
-        return jax.tree_util.tree_map(
-            lambda leaf: NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded)), tree)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        out = [
+            NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded, _path_str(path)))
+            for path, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- roles ---------------------------------------------------------------
     def param_shardings(self, params):
@@ -95,10 +134,11 @@ class ShardingPlan:
                 g, NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(g), True))), grads)
 
 
-def build_sharding_plan(zero_config, topo: MeshTopology) -> ShardingPlan:
+def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRuleFn] = None) -> ShardingPlan:
     axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if topo.axis_size(a) > 1) or (DATA_AXIS, )
     threshold = zero_config.param_persistence_threshold if zero_config.stage >= 3 else 0
     return ShardingPlan(topo=topo,
                         stage=zero_config.stage,
                         shard_axes=axes,
-                        persistence_threshold=threshold)
+                        persistence_threshold=threshold,
+                        tp_rules=tp_rules)

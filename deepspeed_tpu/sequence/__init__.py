@@ -1,0 +1,1 @@
+from .layer import DistributedAttention, single_all_to_all, ulysses_attention

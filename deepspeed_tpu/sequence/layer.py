@@ -1,0 +1,92 @@
+"""Ulysses sequence parallelism.
+
+Analog of deepspeed/sequence/layer.py (``single_all_to_all:15``, ``_SeqAllToAll:44``,
+``DistributedAttention:60``): inputs arrive sequence-sharded [B, S/P, H, D]; an
+all-to-all swaps the shard dim so each rank holds the FULL sequence for H/P heads;
+any local attention runs; a reverse all-to-all restores sequence sharding.  Comm
+volume per link is O(S/P) vs O(S) for Megatron-style SP (blog analysis
+blogs/deepspeed-ulysses/README.md:100-130).
+
+Two TPU-native forms are provided:
+
+- ``ulysses_attention`` — GSPMD form: sharding *constraints* around a local
+  attention; XLA lowers the resharding to ICI all-to-alls.  Use inside pjit-ted
+  models (this is what models.* wire in via ``attention_fn``).
+- ``DistributedAttention`` — explicit shard_map form with ``lax.all_to_all``,
+  mirroring the reference module for users composing their own shard_map programs.
+"""
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.mesh import SEQUENCE_AXIS, MeshTopology, get_topology
+from ..utils.logging import logger
+
+
+def single_all_to_all(x, scatter_idx: int, gather_idx: int, axis_name: str = SEQUENCE_AXIS):
+    """In-graph all-to-all (reference single_all_to_all, sequence/layer.py:15):
+    scatter local dim ``scatter_idx`` across the axis, gather the axis into dim
+    ``gather_idx``.  Call under shard_map."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+def ulysses_attention(local_attn: Optional[Callable] = None,
+                      topo: Optional[MeshTopology] = None,
+                      seq_axis: str = SEQUENCE_AXIS):
+    """Wrap a local attention fn (q,k,v:[B,S,H,D] -> [B,S,H,D]) with Ulysses
+    head-scatter/seq-gather resharding, expressed as GSPMD constraints.
+
+    Returns an ``attention_fn(q, k, v, causal=..., mask=...)`` drop-in for
+    models.transformer.attention_block.  Outside a mesh with a >1 'sequence'
+    axis it degrades to the plain local attention.
+    """
+    from ..models.transformer import sdpa
+    attn = local_attn or sdpa
+
+    def attention_fn(q, k, v, causal=True, mask=None, **kw):
+        t = topo or get_topology()
+        if t.axis_size(seq_axis) <= 1:
+            return attn(q, k, v, causal=causal, mask=mask, **kw)
+        mesh = t.mesh
+        # [B, S(sharded), H, D] -> [B, S, H(sharded), D]: all-to-all via resharding
+        head_sharded = NamedSharding(mesh, PartitionSpec(None, None, seq_axis, None))
+        seq_sharded = NamedSharding(mesh, PartitionSpec(None, seq_axis, None, None))
+        q2 = lax.with_sharding_constraint(q, head_sharded)
+        k2 = lax.with_sharding_constraint(k, head_sharded)
+        v2 = lax.with_sharding_constraint(v, head_sharded)
+        out = attn(q2, k2, v2, causal=causal, mask=mask, **kw)
+        return lax.with_sharding_constraint(out, seq_sharded)
+
+    return attention_fn
+
+
+class DistributedAttention:
+    """Explicit shard_map form (reference DistributedAttention, sequence/layer.py:60).
+
+    __call__(q, k, v) with locally-sharded [B, s/P, H, D] blocks inside a
+    shard_map over ``seq_axis``; runs all-to-all (heads scattered, seq gathered),
+    the local attention on [B, S, H/P, D], and the reverse all-to-all.
+    """
+
+    def __init__(self, local_attention: Callable, seq_axis: str = SEQUENCE_AXIS,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.seq_axis = seq_axis
+        self.scatter_idx = scatter_idx  # heads dim
+        self.gather_idx = gather_idx  # seq dim
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        a2a = functools.partial(single_all_to_all, scatter_idx=self.scatter_idx,
+                                gather_idx=self.gather_idx, axis_name=self.seq_axis)
+        q = a2a(query)
+        k = a2a(key)
+        v = a2a(value)
+        context = self.local_attn(q, k, v, *args, **kwargs)
+        # reverse: scatter seq, gather heads
+        return single_all_to_all(context, scatter_idx=self.gather_idx, gather_idx=self.scatter_idx,
+                                 axis_name=self.seq_axis)

@@ -1,0 +1,91 @@
+"""MoE tests — analog of tests/unit/moe/test_moe.py (gating correctness, EP
+groups): gate math invariants, capacity dropping, dispatch/combine roundtrip,
+expert-parallel parity with single-device execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe import MoE, TopKGate, top1gating, top2gating
+from deepspeed_tpu.moe.experts import init_swiglu_experts, swiglu_experts
+from deepspeed_tpu.parallel import MeshTopology, set_topology
+
+
+def test_top1_gating_shapes_and_mass():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(16, 4)))
+    out = top1gating(logits, capacity_factor=2.0)
+    s, e = logits.shape
+    assert out.combine_weights.shape[0] == s and out.combine_weights.shape[1] == e
+    # each kept token contributes exactly its gate prob; combine sums <= 1
+    per_token = np.asarray(out.combine_weights.sum(axis=(1, 2)))
+    assert (per_token <= 1.0 + 1e-6).all()
+    assert int(out.exp_counts.sum()) <= s
+
+
+def test_top1_aux_loss_uniform_is_one():
+    # perfectly uniform routing => l_aux == 1.0 (E * sum(1/E * 1/E * E))
+    s, e = 64, 4
+    logits = jnp.tile(jnp.eye(e), (s // e, 1)) * 10.0
+    out = top1gating(logits, capacity_factor=4.0)
+    np.testing.assert_allclose(float(out.l_aux), 1.0, rtol=0.1)
+
+
+def test_top1_capacity_drops_tokens():
+    # all tokens route to expert 0; capacity 4 keeps only 4
+    logits = jnp.zeros((16, 4)).at[:, 0].set(10.0)
+    out = top1gating(logits, capacity_factor=1.0, min_capacity=4)
+    assert int(out.exp_counts[0]) == 4
+
+
+def test_top2_gating_two_experts_per_token():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(16, 4)))
+    out = top2gating(logits, capacity_factor=4.0)
+    picks = np.asarray(out.dispatch_mask.sum(axis=(1, 2)))
+    assert (picks == 2).all()
+    # renormalized weights sum to 1 per token
+    np.testing.assert_allclose(np.asarray(out.combine_weights.sum(axis=(1, 2))), 1.0, rtol=1e-5)
+
+
+def test_moe_layer_identity_routing():
+    """With capacity ample and k=1, MoE(x) == chosen_expert(x) * gate_prob."""
+    set_topology(MeshTopology.from_axis_dict({"data": 8}))
+    moe = MoE(hidden_size=16, expert_intermediate_size=32, num_experts=4, k=1, capacity_factor=8.0)
+    params = moe.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32))
+    out, l_aux = moe(params, x)
+    assert out.shape == x.shape
+    assert np.isfinite(float(l_aux))
+    # manual per-token check
+    logits = np.asarray(x.astype(jnp.float32) @ params["gate"]["wg"])
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    chosen = np.argmax(logits, axis=-1)
+    full = np.asarray(swiglu_experts(params["experts"], jnp.tile(x[None], (4, 1, 1))))
+    expected = np.stack([full[chosen[i], i] * float(probs[i, chosen[i]]) for i in range(8)])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_expert_parallel_parity():
+    """EP over 8 devices must match single-device MoE output."""
+    topo1 = MeshTopology.from_axis_dict({"data": 8})
+    set_topology(topo1)
+    moe = MoE(hidden_size=16, expert_intermediate_size=32, num_experts=8, k=2, capacity_factor=4.0)
+    params = moe.init(jax.random.PRNGKey(1))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(32, 16)).astype(np.float32))
+    base, l_base = moe(params, x, topo=topo1)
+
+    topo8 = MeshTopology.from_axis_dict({"expert": 8})
+    set_topology(topo8)
+    out, l_ep = jax.jit(lambda p, v: moe(p, v, topo=topo8))(params, x)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(out), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(l_base), float(l_ep), rtol=1e-5)
+
+
+def test_moe_num_experts_divisibility():
+    with pytest.raises(ValueError):
+        MoE(hidden_size=8, num_experts=6, ep_size=4)
+
+
+def test_gate_k_validation():
+    with pytest.raises(ValueError):
+        TopKGate(8, 4, k=3)
