@@ -1,0 +1,1 @@
+from .module import PipelineModule, partition_layers, pipe_rules, restack_for_pipeline

@@ -1,0 +1,148 @@
+"""Pipeline parallelism.
+
+Analog of deepspeed/runtime/pipe/ (``PipelineModule`` module.py:86, 1F1B
+``TrainSchedule`` schedule.py:189, interpreter engine.py:1357, p2p.py send/recv).
+
+TPU-native design: instead of a per-rank instruction interpreter with eager p2p,
+the pipeline is ONE differentiable program — a ``lax.scan`` over schedule ticks
+inside ``shard_map`` over the 'pipe' mesh axis.  Each tick every stage applies
+its layer block and passes activations to the next stage with ``ppermute`` (the
+p2p.send/recv analog, riding ICI neighbor links).  Bubble slots compute on
+garbage that is masked out of the output buffer — the standard circular-pipeline
+formulation.  Because ``ppermute``/``scan``/``where`` are differentiable, XLA
+derives the reverse (backward) pipeline automatically, replacing the reference's
+hand-scheduled BackwardPass/SendGrad/RecvGrad instructions.
+
+Layer placement: stacked layer params carry leading dims [S, L/S, ...]
+(``partition_layers`` = the reference's uniform ``_partition_layers`` method,
+module.py:370); the 'pipe'-sharded dim 0 puts each stage's block on its devices.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.mesh import DATA_AXIS, PIPE_AXIS, MeshTopology, get_topology
+
+
+def partition_layers(num_layers: int, num_stages: int):
+    """Uniform layer->stage split (reference ``partition_method='uniform'``,
+    pipe/module.py:370).  Requires divisibility (parameters-balanced splits can
+    be layered on top)."""
+    if num_layers % num_stages != 0:
+        raise ValueError(f"num_layers({num_layers}) must divide evenly into num_stages({num_stages})")
+    return num_layers // num_stages
+
+
+def restack_for_pipeline(layer_params, num_stages: int):
+    """[L, ...] stacked leaves -> [S, L/S, ...] for 'pipe' dim-0 sharding."""
+
+    def fix(leaf):
+        L = leaf.shape[0]
+        per = partition_layers(L, num_stages)
+        return leaf.reshape(num_stages, per, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(fix, layer_params)
+
+
+class PipelineModule:
+    """Bundle a per-layer function into a pipelined block.
+
+    layer_fn(layer_params, x) -> x  — one layer's forward (params unstacked).
+    ``__call__(stacked_params, x_microbatches)`` runs the full pipeline:
+    x_microbatches [M, mb, ...] -> outputs [M, mb, ...].
+    """
+
+    def __init__(self, layer_fn: Callable, num_stages: int, remat: bool = True,
+                 topo: Optional[MeshTopology] = None):
+        self.layer_fn = layer_fn
+        self.num_stages = num_stages
+        self.remat = remat
+        self._topo = topo
+
+    @property
+    def topo(self):
+        return self._topo or get_topology()
+
+    def _stage_fn(self):
+        layer_fn = self.layer_fn
+
+        def stage(stage_params, x):
+            # scan this stage's L/S layers
+            def body(h, lp):
+                return layer_fn(lp, h), None
+
+            if self.remat:
+                body = jax.checkpoint(body)
+            x, _ = lax.scan(body, x, stage_params)
+            return x
+
+        return stage
+
+    def __call__(self, stacked_params, x_microbatches):
+        topo = self.topo
+        S = topo.axis_size(PIPE_AXIS)
+        if S <= 1:
+            # no pipe axis: plain scan over all layers (params [S, L/S, ...] -> [L, ...])
+            flat = jax.tree_util.tree_map(lambda l: l.reshape(-1, *l.shape[2:]), stacked_params)
+            stage = self._stage_fn()
+            return jax.vmap(lambda mb: stage(flat, mb))(x_microbatches) if x_microbatches.ndim > 2 else \
+                stage(flat, x_microbatches)
+        if S != self.num_stages:
+            raise ValueError(f"mesh pipe axis ({S}) != num_stages ({self.num_stages})")
+        stage_fn = self._stage_fn()
+        M = x_microbatches.shape[0]
+        if M < S:
+            raise ValueError(f"need at least num_stages({S}) micro-batches, got {M} "
+                             "(pipeline fill requirement; reference pipe engine asserts the same)")
+
+        dp = topo.axis_size(DATA_AXIS)
+        data_in_batch = dp > 1
+
+        def pipelined(params_local, x_local):
+            # params_local leaves: [1, L/S, ...] (this stage's block)
+            p = jax.tree_util.tree_map(lambda l: l[0], params_local)
+            idx = lax.axis_index(PIPE_AXIS)
+            T = M + S - 1
+            zero_state = jnp.zeros_like(x_local[0])
+            zero_out = jnp.zeros_like(x_local)
+
+            def tick(carry, t):
+                state, outputs = carry
+                feed = x_local[jnp.clip(t, 0, M - 1)]
+                inp = jnp.where(idx == 0, feed, state)
+                out = stage_fn(p, inp)
+                mb_idx = t - (S - 1)
+                valid = jnp.logical_and(mb_idx >= 0, idx == S - 1)
+                upd = lax.dynamic_update_index_in_dim(outputs, out, jnp.clip(mb_idx, 0, M - 1), 0)
+                outputs = jnp.where(valid, upd, outputs)
+                state = lax.ppermute(out, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)])
+                return (state, outputs), None
+
+            (_, outputs), _ = lax.scan(tick, (zero_state, zero_out), jnp.arange(T))
+            # outputs are only real on the last stage; broadcast via masked psum
+            outputs = lax.psum(jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), PIPE_AXIS)
+            return outputs
+
+        mesh = topo.mesh
+        x_spec = PartitionSpec(None, DATA_AXIS) if data_in_batch else PartitionSpec()
+        param_spec = jax.tree_util.tree_map(lambda _: PartitionSpec(PIPE_AXIS), stacked_params)
+        fn = shard_map(pipelined, mesh=mesh,
+                       in_specs=(param_spec, x_spec),
+                       out_specs=x_spec,
+                       check_vma=False)
+        return fn(stacked_params, x_microbatches)
+
+
+def pipe_rules(path: str, shape):
+    """Sharding rule: pipeline-stacked leaves (path prefix 'pipe_layers') shard
+    dim 0 over 'pipe' — used by the plan like tp_rules."""
+    if path.startswith("pipe_layers") or ".pipe_layers" in path:
+        return (0, PIPE_AXIS)
+    return None

@@ -34,12 +34,27 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, MeshTopology
 
-# A tp rule maps (dotted param path, shape) -> dim index to shard over the
-# 'tensor' axis, or None.  Models export one (e.g. models.llama.tp_rules) — the
-# built-in analog of Megatron's mpu column/row-parallel layout that the
-# reference consumes externally (deepspeed/__init__.py:95 mpu contract) and
-# AutoTP infers for inference (module_inject/auto_tp.py:188).
-TpRuleFn = Callable[[str, Tuple[int, ...]], Optional[int]]
+# A model-parallel rule maps (dotted param path, shape) to one of:
+#   None                      — no model-parallel sharding for this leaf
+#   int d                     — shard dim d over the 'tensor' axis
+#   (d, axis_name)            — shard dim d over the named mesh axis
+#   [(d1, a1), (d2, a2), ...] — multiple pinned dims (e.g. pipe dim 0 + tp dim 2)
+# Models export one (e.g. models.llama.tp_rules) — the built-in analog of
+# Megatron's mpu column/row-parallel layout the reference consumes externally
+# (deepspeed/__init__.py:95 mpu contract) and AutoTP infers for inference
+# (module_inject/auto_tp.py:188); pipeline stages pin dim 0 over 'pipe'
+# (runtime/pipe/module.py pipe_rules).
+TpRuleFn = Callable[[str, Tuple[int, ...]], Any]
+
+
+def _normalize_rule(out) -> list:
+    if out is None:
+        return []
+    if isinstance(out, int):
+        return [(out, TENSOR_AXIS)]
+    if isinstance(out, tuple) and len(out) == 2 and isinstance(out[0], int):
+        return [out]
+    return list(out)
 
 
 def _path_str(path) -> str:
@@ -71,14 +86,13 @@ class ShardingPlan:
         if len(shape) == 0:
             return PartitionSpec()
         spec = [None] * len(shape)
-        tp_dim = None
-        if self.tp_rules is not None and self.topo.axis_size(TENSOR_AXIS) > 1:
-            tp_dim = self.tp_rules(path, tuple(shape))
-            if tp_dim is not None:
-                if shape[tp_dim] % self.topo.axis_size(TENSOR_AXIS) != 0:
-                    tp_dim = None
-                else:
-                    spec[tp_dim] = TENSOR_AXIS
+        pinned = {}
+        if self.tp_rules is not None:
+            for dim, axis in _normalize_rule(self.tp_rules(path, tuple(shape))):
+                axis_size = self.topo.axis_size(axis)
+                if axis_size > 1 and shape[dim] % axis_size == 0:
+                    spec[dim] = axis
+                    pinned[dim] = axis
         if not sharded:
             return PartitionSpec(*spec)
         world = 1
@@ -87,15 +101,18 @@ class ShardingPlan:
         if world == 1 or int(np.prod(shape)) <= self.persistence_threshold:
             return PartitionSpec(*spec)
         zero_axes = self.shard_axes if len(self.shard_axes) > 1 else self.shard_axes[0]
-        # largest dim divisible by the shard world, excluding the tp dim;
-        # fall back to stacking zero axes onto the tp dim if it alone divides
-        candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0 and d != tp_dim]
+        # largest dim divisible by the shard world, excluding pinned dims;
+        # fall back to stacking zero axes onto a pinned dim if it alone divides
+        candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0 and d not in pinned]
         if candidates:
             dim = max(candidates, key=lambda t: t[1])[0]
             spec[dim] = zero_axes
-        elif tp_dim is not None and shape[tp_dim] % (world * self.topo.axis_size(TENSOR_AXIS)) == 0:
+        else:
             za = self.shard_axes if len(self.shard_axes) > 1 else (self.shard_axes[0], )
-            spec[tp_dim] = (TENSOR_AXIS, *za)
+            for dim, axis in pinned.items():
+                if shape[dim] % (world * self.topo.axis_size(axis)) == 0:
+                    spec[dim] = (axis, *za)
+                    break
         return PartitionSpec(*spec)
 
     def _tree_shardings(self, tree, sharded: bool):
