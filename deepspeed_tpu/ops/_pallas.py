@@ -1,0 +1,13 @@
+"""Shared Pallas dispatch policy for the ops kernels.
+
+One flag + one predicate, imported by flash/fused_adam/quantize so tests can
+monkeypatch a single module and dispatch-policy changes happen in one place.
+"""
+
+import jax
+
+INTERPRET = False  # flipped by tests / debugging
+
+
+def use_pallas() -> bool:
+    return INTERPRET or jax.default_backend() == "tpu"

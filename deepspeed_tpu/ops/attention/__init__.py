@@ -1,0 +1,2 @@
+"""Attention kernels (Pallas flash attention; reference csrc/transformer analog)."""
+from .flash import flash_attention

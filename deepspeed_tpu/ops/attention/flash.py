@@ -1,0 +1,326 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+TPU-native replacement for the reference's fused attention kernels
+(csrc/transformer/ds_attention.cu and the blocked-flash wrappers in
+deepspeed/inference/v2/kernels/ragged_ops/): an online-softmax blocked kernel
+that never materialises the [S, S] score matrix, keeping HBM traffic at
+O(S * D) and feeding the MXU [block_q, d] x [d, block_k] tiles.
+
+Layout: q/k/v are [B, S, H, D] (model layout); the kernel grid is
+(batch, q_head, q_block, k_block) with the k_block axis innermost so the fp32
+accumulators in VMEM scratch carry across k steps.  GQA maps q-head -> kv-head
+in the k/v index_map (no jnp.repeat materialisation).  Backward recomputes
+scores from the saved logsumexp (flash-attention-2 style): one kernel
+accumulates dk/dv over q blocks, one accumulates dq over k blocks.
+
+Falls back to the XLA soft(max) path off-TPU unless interpret mode is forced
+(tests run interpret=True on CPU).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+from .._pallas import use_pallas as _use_pallas
+from .. import _pallas
+
+
+# --------------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *, scale,
+                causal, block_q, block_k, kv_len, offset):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+    # whole k block above the causal diagonal -> skip compute entirely
+    should_run = jnp.logical_or(not causal, k_start <= q_start + offset + block_q - 1)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kpos < kv_len  # padded keys
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + offset)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_sc[:, 0:1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_sc[:, 0:1] = l_sc[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:, 0:1] = m_new
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_sc[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        # lane-replicated [bq, 128] (TPU block constraint: last dim 128)
+        lse_ref[0, 0] = jnp.broadcast_to(m_sc[:, 0:1] + jnp.log(l_safe), (block_q, 128))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    block_q = min(block_q, max(8, sq))
+    block_k = min(block_k, max(8, sk))
+    sq_p = int(np.ceil(sq / block_q)) * block_q
+    sk_p = int(np.ceil(sk / block_k)) * block_k
+    qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    kt = jnp.pad(k.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    vt = jnp.pad(v.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    grid = (b, hq, sq_p // block_q, sk_p // block_k)
+    group = hq // hk
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, kv_len=sk,
+                               offset=sk - sq)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, iq, ik: (bi, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, iq, ik: (bi, h // group, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq_p, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_pallas.INTERPRET,
+    )(qt, kt, vt)
+    return out[:, :, :sq].transpose(0, 2, 1, 3), lse[:, :, :sq, 0]
+
+
+# -------------------------------------------------------------------- backward
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                     dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k, kv_len,
+                     offset):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start, k_start = iq * block_q, ik * block_k
+    should_run = jnp.logical_or(not causal, k_start <= q_start + offset + block_q - 1)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]  # [bq, 1] (lane-replicated input)
+        delta = delta_ref[0, 0, :, 0:1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kpos < kv_len
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, kv_len, offset):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start, k_start = iq * block_q, ik * block_k
+    should_run = jnp.logical_or(not causal, k_start <= q_start + offset + block_q - 1)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0:1]
+        delta = delta_ref[0, 0, :, 0:1]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = kpos < kv_len
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + offset)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    do = g
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    group = hq // hk
+    block_q = min(block_q, max(8, sq))
+    block_k = min(block_k, max(8, sk))
+    sq_p = int(np.ceil(sq / block_q)) * block_q
+    sk_p = int(np.ceil(sk / block_k)) * block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,S,H]
+    delta = delta.transpose(0, 2, 1)  # [B,H,S]
+
+    def padq(x):  # [B,S,H,D] -> [B,H,Sp,D]
+        return jnp.pad(x.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - x.shape[1]), (0, 0)))
+
+    def padk(x):
+        return jnp.pad(x.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sk_p - x.shape[1]), (0, 0)))
+
+    qt, kt, vt, dot = padq(q), padk(k), padk(v), padq(do)
+    lse_p = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - sq)))
+    delta_p = jnp.pad(delta, ((0, 0), (0, 0), (0, sq_p - sq)))
+    lse_p = jnp.broadcast_to(lse_p[..., None], lse_p.shape + (128, ))
+    delta_p = jnp.broadcast_to(delta_p[..., None], delta_p.shape + (128, ))
+    nq, nk = sq_p // block_q, sk_p // block_k
+
+    # dk/dv: one pass per q-head (GQA heads accumulate via XLA add after)
+    kern = functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k, kv_len=sk, offset=sk - sq)
+    dk_h, dv_h = pl.pallas_call(
+        kern,
+        grid=(b, hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, ik, iq: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, ik, iq: (bi, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, ik, iq: (bi, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, ik, iq: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, h, ik, iq: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, h, ik, iq: (bi, h, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, ik, iq: (bi, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, ik, iq: (bi, h, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sk_p, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_pallas.INTERPRET,
+    )(qt, kt, vt, dot, lse_p, delta_p)
+    # fold grouped q-heads into their kv head
+    dk = dk_h.reshape(b, hk, group, sk_p, d).sum(axis=2)
+    dv = dv_h.reshape(b, hk, group, sk_p, d).sum(axis=2)
+
+    kern_q = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, kv_len=sk, offset=sk - sq)
+    dq = pl.pallas_call(
+        kern_q,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, iq, ik: (bi, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, h, iq, ik: (bi, h // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_pallas.INTERPRET,
+    )(qt, kt, vt, dot, lse_p, delta_p)
+
+    dq = dq[:, :, :sq].transpose(0, 2, 1, 3)
+    dk = dk[:, :, :sk].transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv[:, :, :sk].transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    return _flash_bwd(scale, causal, block_q, block_k, res, g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, mask=None,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Drop-in for models.transformer.sdpa: q/k/v [B, S, H, D], GQA allowed.
+
+    Dense ``mask`` forces the XLA fallback (the blocked kernel handles only the
+    causal/padding structure); off-TPU also falls back unless interpret mode.
+    """
+    from ...models.transformer import sdpa
+    if mask is not None or not _use_pallas():
+        return sdpa(q, k, v, causal=causal, mask=mask, softmax_scale=softmax_scale)
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    return _flash(q, k, v, scale, causal, block_q, block_k)

@@ -1,0 +1,3 @@
+"""Block quantization kernels (reference csrc/quantization analog)."""
+from .quantize import (dequantize_int4, dequantize_int8, quantize_int4, quantize_int8,
+                       quantized_allgather_int8, quantized_psum_scatter_int4)

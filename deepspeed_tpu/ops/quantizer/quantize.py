@@ -1,0 +1,145 @@
+"""Block quantization kernels — int8/int4 symmetric, per-group scales.
+
+TPU-native analog of the reference's quantizer tree
+(csrc/quantization/{quantize.cu,dequantize.cu,quant_reduce.cu}): used by the
+ZeRO++-style quantized collectives (qwZ int8 weight allgather, qgZ
+hierarchical int4 gradient reduction in runtime/zero/quantized_collectives).
+
+Layout: a flat buffer is viewed as [num_groups, group_size]; each group gets a
+symmetric abs-max fp32 scale.  int4 packs two nibbles per int8 lane.  On TPU
+the quantize step runs as a Pallas kernel (one pass: abs-max + scale + cast);
+off-TPU the identical math runs as XLA ops (tests compare both).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .._pallas import use_pallas as _use_pallas
+from .. import _pallas
+
+
+def _quant_kernel(x_ref, q_ref, s_ref, *, qmax):
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _view_groups(x, group_size):
+    n = x.size
+    g = min(group_size, n)
+    n_pad = int(np.ceil(n / g)) * g
+    return jnp.pad(x.reshape(-1), (0, n_pad - n)).reshape(n_pad // g, g), n
+
+
+def quantize_int8(x, group_size: int = 2048):
+    """x: any shape -> (q int8 [G, gs], scales fp32 [G, 1], orig_size)."""
+    xg, n = _view_groups(x, group_size)
+    if not _use_pallas() or xg.shape[1] % 128 != 0:
+        xf = xg.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+        scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return q, scale, n
+    groups, gs = xg.shape
+    rows = max(8, min(512, groups))
+    g_pad = int(np.ceil(groups / rows)) * rows
+    xg = jnp.pad(xg, ((0, g_pad - groups), (0, 0)))
+    q, s = pl.pallas_call(
+        functools.partial(_quant_kernel, qmax=127.0),
+        grid=(g_pad // rows, ),
+        in_specs=[pl.BlockSpec((rows, gs), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((rows, gs), lambda i: (i, 0)),
+            pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g_pad, gs), jnp.int8),
+            jax.ShapeDtypeStruct((g_pad, 1), jnp.float32),
+        ],
+        interpret=_pallas.INTERPRET,
+    )(xg)
+    return q[:groups], s[:groups], n
+
+
+def dequantize_int8(q, scales, orig_size, shape=None, dtype=jnp.float32):
+    x = (q.astype(jnp.float32) * scales).reshape(-1)[:orig_size].astype(dtype)
+    return x.reshape(shape) if shape is not None else x
+
+
+def quantize_int4(x, group_size: int = 2048):
+    """Symmetric int4 ([-7, 7]) with two values packed per int8.
+
+    Returns (packed int8 [G, gs//2], scales [G, 1], orig_size).
+    """
+    if x.size < group_size and x.size % 2 == 1:
+        group_size = x.size + 1  # keep the group width even for nibble pairing
+    xg, n = _view_groups(x, group_size)
+    if xg.shape[1] % 2 == 1:
+        xg = jnp.pad(xg, ((0, 0), (0, 1)))
+    xf = xg.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 7.0)
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int8)
+    lo, hi = q[:, 0::2], q[:, 1::2]
+    packed = ((hi.astype(jnp.int32) & 0xF) << 4 | (lo.astype(jnp.int32) & 0xF)).astype(jnp.int8)
+    return packed, scale, n
+
+
+def dequantize_int4(packed, scales, orig_size, shape=None, dtype=jnp.float32):
+    p = packed.astype(jnp.int32)
+    lo = (p << 28) >> 28  # sign-extend low nibble
+    hi = (p << 24) >> 28  # sign-extend high nibble
+    g, half = packed.shape
+    q = jnp.stack([lo, hi], axis=-1).reshape(g, half * 2).astype(jnp.float32)
+    x = (q * scales).reshape(-1)[:orig_size].astype(dtype)
+    return x.reshape(shape) if shape is not None else x
+
+
+def quantized_allgather_int8(x, axis_name: str, group_size: int = 2048):
+    """qwZ-style collective: quantize locally, allgather int8 + scales, dequant.
+
+    4x wire traffic reduction vs fp32 allgather (reference
+    partition_parameters.py:1171 zero_quantized_weights path).  Must run inside
+    shard_map/pjit with ``axis_name`` bound.
+    """
+    q, s, n = quantize_int8(x, group_size)
+    q_all = jax.lax.all_gather(q, axis_name)
+    s_all = jax.lax.all_gather(s, axis_name)
+    world = q_all.shape[0]
+    deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, n, dtype=x.dtype))(q_all, s_all)
+    return deq.reshape((world, ) + x.shape)
+
+
+def quantized_psum_scatter_int4(x, axis_name: str, group_size: int = 2048):
+    """qgZ-style gradient reduction: int4 all-to-all then local reduce.
+
+    Maps the reference's swizzled-quantization hierarchical qgZ
+    (csrc/quantization/swizzled_quantize.cu, coalesced_collectives.py:31) to a
+    single-axis quantized reduce-scatter: each rank quantizes its shard-slices,
+    all-to-alls the int4 payload, dequantizes, and reduces locally.  x: [n]
+    with n divisible by axis size * 2.
+    """
+    world = jax.lax.axis_size(axis_name)
+    shard = x.shape[0] // world
+    xs = x.reshape(world, shard)
+    packed, scales, n_per = _quant_a2a_prep(xs, group_size)
+    packed_t = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    scales_t = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0)
+    deq = jax.vmap(lambda qq, ss: dequantize_int4(qq, ss, n_per))(packed_t, scales_t)
+    return jnp.sum(deq, axis=0).astype(x.dtype)
+
+
+def _quant_a2a_prep(xs, group_size):
+    def one(row):
+        packed, scales, _ = quantize_int4(row, group_size)
+        return packed, scales
+    packed, scales = jax.vmap(one)(xs)
+    return packed, scales, xs.shape[1]

@@ -18,8 +18,6 @@ Layer placement: stacked layer params carry leading dims [S, L/S, ...]
 module.py:370); the 'pipe'-sharded dim 0 puts each stage's block on its devices.
 """
 
-import dataclasses
-import functools
 from typing import Any, Callable, Optional, Sequence
 
 import jax
