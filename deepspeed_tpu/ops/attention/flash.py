@@ -118,6 +118,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt)
     return out[:, :, :sq].transpose(0, 2, 1, 3), lse[:, :, :sq, 0]
@@ -260,6 +262,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt, dot, lse_p, delta_p)
     # fold grouped q-heads into their kv head
@@ -282,6 +286,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g):
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt, dot, lse_p, delta_p)
 

@@ -30,6 +30,7 @@ from ..utils.logging import log_dist, logger
 from ..utils.timer import ThroughputTimer
 from . import lr_schedules, optimizers
 from .checkpointing import load_checkpoint_dir, save_checkpoint_dir
+from .grad_accum import accumulate_micro_grads
 from .config import TrainingConfig, load_config
 from .optimizers import (LossScaleState, clip_by_global_norm, global_grad_norm, has_overflow, init_loss_scale,
                          update_loss_scale)
@@ -150,40 +151,66 @@ class Engine:
         fp16 = self.fp16_enabled
         fp16_cfg = self.config.fp16
         clip_norm = self.config.gradient_clipping
+        zero_cfg = self.config.zero_optimization
+        topo = self.topology
+        # ZeRO++ paths need pure dp/fsdp sharding (replicated model axes) and an
+        # actual dp world to save traffic on
+        pure_dp = all(topo.axis_size(a) == 1 for a in ("tensor", "sequence", "expert", "pipe"))
+        dp_world = 1
+        for a in self.plan.shard_axes:
+            dp_world *= topo.axis_size(a)
+        qgz = bool(zero_cfg.zero_quantized_gradients) and 1 <= self.zero_stage <= 2 and pure_dp and dp_world > 1
+        qwz = bool(zero_cfg.zero_quantized_weights) and 1 <= self.zero_stage <= 2 and pure_dp and dp_world > 1
+        hpz = (zero_cfg.zero_hpz_partition_size > 1 and self.zero_stage >= 3
+               and topo.axis_size("fsdp") > 1)
+        if zero_cfg.zero_quantized_gradients and not qgz:
+            log_dist("zero_quantized_gradients requested but inactive (needs stage 1-2, "
+                     "pure dp/fsdp mesh, dp world > 1)", ranks=[0])
+        if zero_cfg.zero_quantized_weights and not qwz:
+            log_dist("zero_quantized_weights requested but inactive (needs stage 1-2, "
+                     "pure dp/fsdp mesh, dp world > 1)", ranks=[0])
+        if zero_cfg.zero_hpz_partition_size > 1 and not hpz:
+            log_dist("zero_hpz_partition_size requested but inactive (needs stage 3 and "
+                     "an fsdp mesh axis > 1)", ranks=[0])
+        if hpz and zero_cfg.zero_hpz_partition_size != topo.axis_size("fsdp"):
+            log_dist(f"hpZ secondary partition follows the fsdp mesh axis "
+                     f"(size {topo.axis_size('fsdp')}), not zero_hpz_partition_size="
+                     f"{zero_cfg.zero_hpz_partition_size}", ranks=[0])
         compute_shardings = None
         if self.zero_stage < 3:
             # Replicated over dp (keeping any tensor-parallel dims sharded): the
             # bit16-allgather analog.  Stage 3 leaves layout to GSPMD so gathers
             # happen per-layer inside the scan, not up front.
             compute_shardings = self.plan.param_shardings(self.state.params)
+        elif hpz:
+            # hpZ secondary partition: compute copy sharded over fsdp only
+            compute_shardings = self.plan.secondary_shardings(self.state.params)
 
         def cast_for_compute(master):
+            if qwz:
+                from .zero.quantized import qwz_cast_gather
+                return qwz_cast_gather(master, topo.mesh, plan.shard_axes, compute_dtype, plan=plan)
             p16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), master)
             if compute_shardings is not None:
                 p16 = jax.tree_util.tree_map(jax.lax.with_sharding_constraint, p16, compute_shardings)
             return p16
 
+        qgz_grad_fn = None
+        if qgz:
+            from .zero.quantized import make_qgz_grad_fn
+            qgz_grad_fn = make_qgz_grad_fn(loss_fn, topo.mesh, plan.shard_axes, gas)
+
         def train_step(state: TrainState, batch) -> Tuple[TrainState, StepMetrics]:
             rng, step_rng = jax.random.split(state.rng)
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             params16 = cast_for_compute(state.params)
-
-            def micro(carry, micro_batch_and_rng):
-                grads_acc, loss_acc = carry
-                micro_batch, mrng = micro_batch_and_rng
-
-                def scaled_loss(p16):
-                    out = loss_fn(p16, micro_batch, mrng)
-                    loss = out[0] if isinstance(out, tuple) else out
-                    return loss.astype(jnp.float32) * scale
-
-                loss, grads = jax.value_and_grad(scaled_loss)(params16)
-                grads = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
-                return (grads, loss_acc + loss / scale), None
-
-            zero_grads = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
             micro_rngs = jax.random.split(step_rng, gas)
-            (grads, loss_sum), _ = jax.lax.scan(micro, (zero_grads, jnp.float32(0.0)), (batch, micro_rngs))
+
+            if qgz_grad_fn is not None:
+                # qgZ: explicit int4-quantized dp gradient reduction (shard_map)
+                grads, loss_sum = qgz_grad_fn(params16, batch, micro_rngs, scale)
+            else:
+                grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, micro_rngs, scale)
 
             # average over micro-batches and unscale; dp reduction happens via
             # sharding propagation (data-sharded batch -> psum/reduce-scatter)

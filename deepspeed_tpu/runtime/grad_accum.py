@@ -1,0 +1,38 @@
+"""Shared micro-batch gradient accumulation scan.
+
+One implementation used by both the GSPMD train step (engine.py) and the
+explicit-collective qgZ path (zero/quantized.py) so the two stay numerically
+identical — the analog of the reference's single backward/IPG pipeline feeding
+both the plain and quantized reduction paths (stage_1_and_2.py:910).
+"""
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def accumulate_micro_grads(loss_fn: Callable, params16, batch, micro_rngs,
+                           scale) -> Tuple[Any, jnp.ndarray]:
+    """lax.scan over gradient-accumulation micro-batches.
+
+    batch leaves are [gas, ...]; returns (summed fp32 grads, summed unscaled
+    loss).  ``scale`` is the fp16 loss scale (1.0 for bf16).
+    """
+
+    def micro(carry, micro_batch_and_rng):
+        grads_acc, loss_acc = carry
+        micro_batch, mrng = micro_batch_and_rng
+
+        def scaled_loss(p16):
+            out = loss_fn(p16, micro_batch, mrng)
+            loss = out[0] if isinstance(out, tuple) else out
+            return loss.astype(jnp.float32) * scale
+
+        loss, grads = jax.value_and_grad(scaled_loss)(params16)
+        grads = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+        return (grads, loss_acc + loss / scale), None
+
+    zero_grads = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params16)
+    (grads, loss_sum), _ = jax.lax.scan(micro, (zero_grads, jnp.float32(0.0)), (batch, micro_rngs))
+    return grads, loss_sum

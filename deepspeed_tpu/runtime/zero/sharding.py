@@ -82,7 +82,8 @@ class ShardingPlan:
     persistence_threshold: int = 0
     tp_rules: Optional[TpRuleFn] = None
 
-    def _spec_for_shape(self, shape, sharded: bool, path: str = "") -> PartitionSpec:
+    def _spec_for_shape(self, shape, sharded: bool, path: str = "", axes=None) -> PartitionSpec:
+        shard_axes = tuple(axes) if axes is not None else self.shard_axes
         if len(shape) == 0:
             return PartitionSpec()
         spec = [None] * len(shape)
@@ -96,11 +97,11 @@ class ShardingPlan:
         if not sharded:
             return PartitionSpec(*spec)
         world = 1
-        for a in self.shard_axes:
+        for a in shard_axes:
             world *= self.topo.axis_size(a)
         if world == 1 or int(np.prod(shape)) <= self.persistence_threshold:
             return PartitionSpec(*spec)
-        zero_axes = self.shard_axes if len(self.shard_axes) > 1 else self.shard_axes[0]
+        zero_axes = shard_axes if len(shard_axes) > 1 else shard_axes[0]
         # largest dim divisible by the shard world, excluding pinned dims;
         # fall back to stacking zero axes onto a pinned dim if it alone divides
         candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0 and d not in pinned]
@@ -108,17 +109,17 @@ class ShardingPlan:
             dim = max(candidates, key=lambda t: t[1])[0]
             spec[dim] = zero_axes
         else:
-            za = self.shard_axes if len(self.shard_axes) > 1 else (self.shard_axes[0], )
+            za = shard_axes if len(shard_axes) > 1 else (shard_axes[0], )
             for dim, axis in pinned.items():
                 if shape[dim] % (world * self.topo.axis_size(axis)) == 0:
                     spec[dim] = (axis, *za)
                     break
         return PartitionSpec(*spec)
 
-    def _tree_shardings(self, tree, sharded: bool):
+    def _tree_shardings(self, tree, sharded: bool, axes=None):
         flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
         out = [
-            NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded, _path_str(path)))
+            NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded, _path_str(path), axes=axes))
             for path, leaf in flat
         ]
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -135,6 +136,13 @@ class ShardingPlan:
     def opt_state_shardings(self, opt_state):
         """Optimizer moments: sharded from stage 1 up (scalars replicated)."""
         return self._tree_shardings(opt_state, sharded=self.stage >= 1)
+
+    def secondary_shardings(self, params):
+        """hpZ secondary partition (reference zero_hpz_partition_size,
+        partition_parameters.py:1171): the compute copy sharded over the fast
+        intra-slice 'fsdp' axis only — the 'data' gather happens ONCE at the
+        secondary materialization, per-layer gathers then ride fsdp/ICI."""
+        return self._tree_shardings(params, sharded=True, axes=(FSDP_AXIS, ))
 
     def grad_shardings(self, grads):
         """Gradients: sharded from stage 2 up (reduce-scatter instead of allreduce)."""
