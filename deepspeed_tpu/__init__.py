@@ -70,6 +70,12 @@ def initialize(args=None,
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 
+def init_inference(*args, **kwargs):
+    """Reference deepspeed.init_inference (__init__.py:263) — see inference.engine."""
+    from .inference import init_inference as _init
+    return _init(*args, **kwargs)
+
+
 def add_config_arguments(parser):
     """Reference add_config_arguments (__init__.py:240)."""
     group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")

@@ -1,0 +1,4 @@
+"""Inference engines (reference deepspeed/inference/)."""
+from .auto_tp import auto_tp_rules
+from .config import InferenceConfig, load_inference_config
+from .engine import InferenceEngine, init_inference

@@ -1,0 +1,45 @@
+"""AutoTP — automatic tensor-parallel sharding-rule inference.
+
+Analog of the reference's AutoTP (module_inject/auto_tp.py:188): the reference
+walks the module tree matching nn.Linear names to decide row- vs column-
+parallel slicing; here we pattern-match param-pytree paths (our model naming
+AND common HF naming) and emit the same column/row layout as a TpRuleFn the
+sharding plan consumes (runtime/zero/sharding.py).
+
+Column-parallel (shard output dim): q/k/v projections, MLP up/gate, lm head.
+Row-parallel (shard input dim): attention output proj, MLP down proj.
+"""
+
+import re
+from typing import Optional, Tuple
+
+# output-dim-sharded (column-parallel) path suffixes
+_COLUMN_PAT = re.compile(
+    r"(wq|wk|wv|w_gate|w_up|w_fc1|q_proj|k_proj|v_proj|gate_proj|up_proj|query|key|value|"
+    r"c_attn|fc_in|wi|lm_head)$")
+# input-dim-sharded (row-parallel)
+_ROW_PAT = re.compile(r"(wo|w_down|w_fc2|o_proj|down_proj|dense|c_proj|fc_out|wo_out)$")
+
+
+def infer_rule(path: str, shape: Tuple[int, ...]) -> Optional[int]:
+    """Map a param path to a shard dim over the 'tensor' axis (or None).
+
+    Stacked-layer leaves carry a leading L dim, so 2D [in, out] weights appear
+    as 3D [L, in, out]: dims shift by one.
+    """
+    if len(shape) < 2:
+        return None
+    leaf = path.split(".")[-1]
+    base = len(shape) - 2  # index of the 'in' dim
+    if _COLUMN_PAT.search(leaf):
+        return base + 1
+    if _ROW_PAT.search(leaf):
+        return base
+    if leaf == "embed":  # vocab-parallel embedding (reference embedding sharding)
+        return None
+    return None
+
+
+def auto_tp_rules(path: str, shape) -> Optional[int]:
+    """TpRuleFn entry point: plug into initialize(tp_rules=...) or InferenceEngine."""
+    return infer_rule(path, tuple(shape))

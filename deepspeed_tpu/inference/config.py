@@ -1,0 +1,50 @@
+"""Inference configuration — analog of DeepSpeedInferenceConfig
+(deepspeed/inference/config.py: DeepSpeedTPConfig:47, quantization/moe blocks).
+"""
+
+from typing import Any, Dict, Optional
+
+from ..runtime.config_utils import ConfigModel, Field
+
+
+class TPConfig(ConfigModel):
+    """Reference DeepSpeedTPConfig (inference/config.py:47)."""
+    enabled: bool = True
+    tp_size: int = Field(1, ge=1)
+
+
+class QuantConfig(ConfigModel):
+    """Weight-only quantization for serving (reference inference/quantization)."""
+    enabled: bool = False
+    bits: int = Field(8, choices=(4, 8))
+    group_size: int = Field(2048, ge=8)
+
+
+class InferenceConfig(ConfigModel):
+    """Reference DeepSpeedInferenceConfig (inference/config.py)."""
+    dtype: str = Field("bfloat16", choices=("float32", "bfloat16", "float16"))
+    tensor_parallel: Optional[TPConfig] = None
+    max_out_tokens: int = Field(1024, ge=1)
+    min_out_tokens: int = Field(1, ge=1)
+    max_seq_len: Optional[int] = None
+    replace_with_kernel_inject: bool = False  # Pallas flash decode path
+    quant: Optional[QuantConfig] = None
+    # sampling defaults
+    temperature: float = Field(1.0, ge=0.0)
+    top_k: int = Field(0, ge=0)
+    top_p: float = Field(1.0, gt=0.0, le=1.0)
+    seed: int = 0
+
+    def model_validate(self):
+        if self.tensor_parallel is None:
+            object.__setattr__(self, "tensor_parallel", TPConfig())
+        if self.quant is None:
+            object.__setattr__(self, "quant", QuantConfig())
+
+
+def load_inference_config(config) -> InferenceConfig:
+    if config is None:
+        return InferenceConfig()
+    if isinstance(config, InferenceConfig):
+        return config
+    return InferenceConfig(**dict(config))

@@ -1,0 +1,200 @@
+"""Inference engine (v1) — TP-sharded generation with a jitted prefill/decode split.
+
+Analog of the reference InferenceEngine (deepspeed/inference/engine.py:39): the
+reference injects CUDA kernels into a HF module tree and shards weights over a
+TP process group; here the model is a pure function + params pytree, TP is a
+mesh axis with AutoTP-derived shardings (auto_tp.py), and the CUDA-graph
+capture step (engine.py:524) is subsumed by jit compilation of two programs:
+
+  prefill(params, ids, cache)        -> (logits, cache)   # full prompt
+  decode(params, last_token, cache)  -> (logits, cache)   # one token, reused
+
+Generation loops decode on-device state; only sampled tokens come back to host.
+"""
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel.mesh import MeshTopology, TENSOR_AXIS
+from ..runtime.zero.sharding import ShardingPlan
+from ..utils.logging import log_dist
+from .auto_tp import auto_tp_rules
+from .config import InferenceConfig, load_inference_config
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class InferenceEngine:
+    """Serve a model-family module (models.llama-style: needs forward_with_cache
+    + init_cache) with TP sharding and incremental decoding."""
+
+    def __init__(self, model_module, model_config, params,
+                 config: Optional[Dict] = None,
+                 topology: Optional[MeshTopology] = None,
+                 tp_rules: Optional[Callable] = None,
+                 attention_fn: Optional[Callable] = None):
+        self.config = load_inference_config(config)
+        self.model = model_module
+        self.model_config = model_config
+        tp_size = self.config.tensor_parallel.tp_size
+        # wildcard data axis soaks up remaining local devices (replicated serve)
+        self.topology = topology or MeshTopology.from_axis_dict({TENSOR_AXIS: tp_size, "data": -1})
+        self.dtype = _DTYPES[self.config.dtype]
+        self.attention_fn = attention_fn
+        rules = tp_rules if tp_rules is not None else (
+            getattr(model_module, "tp_rules", None) or auto_tp_rules)
+        # ZeRO stage 0 plan: TP rules only, everything else replicated
+        class _NoZero:
+            stage = 0
+            param_persistence_threshold = 0
+        from ..runtime.zero.sharding import build_sharding_plan
+        self.plan = build_sharding_plan(_NoZero(), self.topology, tp_rules=rules)
+
+        if self.config.quant.enabled:
+            params = self._quantize_dequantize(params)
+        self.params = self._shard_params(params)
+        self._prefill = None
+        self._decode = None
+        self._samplers = {}
+        log_dist(f"InferenceEngine: tp={self.topology.axis_size(TENSOR_AXIS)} "
+                 f"dtype={self.config.dtype}", ranks=[0])
+
+    # ----------------------------------------------------------------- setup
+    def _shard_params(self, params):
+        cast = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.dtype), params)
+        shardings = self.plan.param_shardings(cast)
+        return jax.jit(lambda p: p, out_shardings=shardings)(cast)
+
+    def _quantize_dequantize(self, params):
+        """Weight-only fake quantization (reference inference/quantization WOQ):
+        int8/int4 block-quantize then dequantize — serving-memory layout is a
+        follow-up; numerics match the quantized checkpoint."""
+        from ..ops.quantizer import (dequantize_int4, dequantize_int8, quantize_int4, quantize_int8)
+        bits = self.config.quant.bits
+        gs = self.config.quant.group_size
+
+        def q(x):
+            if x.ndim < 2 or x.size < gs:
+                return x
+            if bits == 8:
+                qq, ss, n = quantize_int8(x, gs)
+                return dequantize_int8(qq, ss, n, shape=x.shape, dtype=x.dtype)
+            qq, ss, n = quantize_int4(x, gs)
+            return dequantize_int4(qq, ss, n, shape=x.shape, dtype=x.dtype)
+
+        return jax.tree_util.tree_map(q, params)
+
+    # ------------------------------------------------------------ compiled fns
+    def _build(self, batch: int, max_seq: int):
+        model, cfg = self.model, self.model_config
+        attn = self.attention_fn
+
+        def prefill(params, ids, cache):
+            return model.forward_with_cache(cfg, params, ids, cache, attention_fn=attn)
+
+        def decode(params, last, cache):
+            return model.forward_with_cache(cfg, params, last, cache, attention_fn=attn)
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode)
+
+    # ---------------------------------------------------------------- forward
+    def forward(self, input_ids):
+        """One full forward returning logits (reference engine.forward:584)."""
+        ids = jnp.asarray(input_ids)
+        cache = self.model.init_cache(self.model_config, ids.shape[0], ids.shape[1],
+                                      dtype=self.dtype)
+        if self._prefill is None:
+            self._build(ids.shape[0], cache["k"].shape[2])
+        logits, _ = self._prefill(self.params, ids, cache)
+        return logits
+
+    __call__ = forward
+
+    # --------------------------------------------------------------- generate
+    def generate(self, input_ids, max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, eos_token_id: Optional[int] = None,
+                 seed: Optional[int] = None):
+        """Autoregressive generation (reference hybrid/generate paths).
+
+        input_ids: [B, S] prompt tokens. Returns np.ndarray [B, S + new]."""
+        ids = jnp.asarray(np.asarray(input_ids))
+        b, s = ids.shape
+        new = max_new_tokens if max_new_tokens is not None else self.config.max_out_tokens
+        temperature = self.config.temperature if temperature is None else temperature
+        top_k = self.config.top_k if top_k is None else top_k
+        top_p = self.config.top_p if top_p is None else top_p
+        model_max = getattr(self.model_config, "max_seq_len", None)
+        max_seq = self.config.max_seq_len or (s + new)
+        if model_max is not None:
+            max_seq = min(max_seq, model_max)
+        if s + new > max_seq:
+            raise ValueError(f"prompt ({s}) + max_new_tokens ({new}) exceeds max_seq_len {max_seq} "
+                             f"(model rotary table covers {model_max} positions)")
+
+        cache = self.model.init_cache(self.model_config, b, max_seq, dtype=self.dtype)
+        if self._prefill is None:
+            self._build(b, max_seq)
+        rng = jax.random.PRNGKey(self.config.seed if seed is None else seed)
+
+        logits, cache = self._prefill(self.params, ids, cache)
+        skey = (temperature, top_k, top_p)
+        if skey not in self._samplers:
+            self._samplers[skey] = jax.jit(
+                functools.partial(_sample, temperature=temperature, top_k=top_k, top_p=top_p))
+        sample = self._samplers[skey]
+        tok, rng = sample(logits[:, -1], rng)
+        out = [np.asarray(tok)]
+        for _ in range(new - 1):
+            logits, cache = self._decode(self.params, tok[:, None], cache)
+            tok, rng = sample(logits[:, -1], rng)
+            out.append(np.asarray(tok))
+            if eos_token_id is not None and bool(np.all(out[-1] == eos_token_id)):
+                break
+        gen = np.stack(out, axis=1)
+        return np.concatenate([np.asarray(ids), gen], axis=1)
+
+
+def _sample(logits, rng, *, temperature, top_k, top_p):
+    """Temperature / top-k / top-p sampling on-device; greedy at T=0."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    rng, sub = jax.random.split(rng)
+    tok = jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32)
+    return tok, rng
+
+
+def init_inference(model_module=None, model_config=None, params=None, config=None,
+                   hf_model=None, **kwargs) -> InferenceEngine:
+    """deepspeed.init_inference analog (reference __init__.py:263).
+
+    Either pass (model_module, model_config, params) explicitly, or a HF
+    LlamaForCausalLM/MistralForCausalLM via ``hf_model`` — converted with
+    models.llama.from_hf_state_dict (load_checkpoint.py analog).
+    """
+    if hf_model is not None:
+        from ..models import llama
+        model_module = llama
+        model_config = llama.config_from_hf(hf_model.config)
+        params = llama.from_hf_state_dict(model_config, hf_model.state_dict())
+    if model_module is None or params is None:
+        raise ValueError("init_inference needs (model_module, model_config, params) or hf_model")
+    return InferenceEngine(model_module, model_config, params, config=config, **kwargs)

@@ -1,0 +1,35 @@
+"""Blocked KV allocator — host-side free list over the paged KV pool.
+
+Analog of the reference BlockedAllocator (inference/v2/ragged/blocked_allocator.py):
+fixed number of KV blocks, O(1) allocate/free via a free list.  The last block
+id is reserved as the trash target for padded writes (models.llama.forward_paged).
+"""
+
+from typing import List
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (1 usable + trash)")
+        self.num_blocks = num_blocks
+        self.trash_block = num_blocks - 1
+        self._free: List[int] = list(range(num_blocks - 1))  # trash never allocated
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"KV pool exhausted: requested {n}, free {len(self._free)}")
+        out = self._free[:n]
+        self._free = self._free[n:]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b == self.trash_block or b < 0 or b >= self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+        self._free.extend(blocks)

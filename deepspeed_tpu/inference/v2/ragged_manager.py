@@ -1,0 +1,89 @@
+"""Ragged state manager — sequence tracking + block-table bookkeeping.
+
+Analog of DSStateManager / DSSequenceDescriptor (inference/v2/ragged/
+ragged_manager.py:19, sequence_descriptor.py): tracks live sequences, grows
+their block tables as tokens are scheduled, and frees blocks at retirement.
+All host-side (numpy); the device sees only the padded block-table array.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .blocked_allocator import BlockedAllocator
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    uid: int
+    tokens: List[int]  # full known token ids (prompt + generated)
+    seen_tokens: int = 0  # tokens already in the KV cache
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def pending_tokens(self) -> int:
+        return len(self.tokens) - self.seen_tokens
+
+    @property
+    def in_prefill(self) -> bool:
+        return self.seen_tokens < len(self.tokens) - 1
+
+
+class RaggedStateManager:
+
+    def __init__(self, num_blocks: int, block_size: int, max_blocks_per_seq: int):
+        self.allocator = BlockedAllocator(num_blocks)
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.seqs: Dict[int, SequenceDescriptor] = {}
+        self.failures: Dict[int, str] = {}
+
+    @property
+    def trash_block(self) -> int:
+        return self.allocator.trash_block
+
+    def add_sequence(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
+        if uid in self.seqs:
+            raise ValueError(f"uid {uid} already tracked")
+        seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens))
+        self.seqs[uid] = seq
+        return seq
+
+    def ensure_blocks(self, seq: SequenceDescriptor, upto_tokens: int) -> None:
+        """Grow the block table to cover ``upto_tokens`` cache positions."""
+        need = (upto_tokens + self.block_size - 1) // self.block_size
+        if need > self.max_blocks_per_seq:
+            raise RuntimeError(f"uid {seq.uid}: {upto_tokens} tokens exceeds "
+                               f"max_blocks_per_seq={self.max_blocks_per_seq}")
+        if need > len(seq.blocks):
+            seq.blocks.extend(self.allocator.allocate(need - len(seq.blocks)))
+
+    def over_cap(self, upto_tokens: int) -> bool:
+        return (upto_tokens + self.block_size - 1) // self.block_size > self.max_blocks_per_seq
+
+    def fail(self, uid: int, reason: str) -> None:
+        self.failures[uid] = reason
+        seq = self.seqs.get(uid)
+        if seq is not None:
+            seq.done = True
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        return self.allocator.free_blocks >= n_blocks
+
+    def blocks_needed(self, seq: SequenceDescriptor, upto_tokens: int) -> int:
+        need = (upto_tokens + self.block_size - 1) // self.block_size
+        return max(0, need - len(seq.blocks))
+
+    def block_table_row(self, seq: SequenceDescriptor) -> np.ndarray:
+        row = np.full(self.max_blocks_per_seq, self.trash_block, np.int32)
+        row[:len(seq.blocks)] = seq.blocks
+        return row
+
+    def retire(self, uid: int) -> None:
+        seq = self.seqs.pop(uid)
+        self.allocator.free(seq.blocks)
+
+    def live_uids(self) -> List[int]:
+        return [uid for uid, s in self.seqs.items() if not s.done]

@@ -1,0 +1,73 @@
+"""Dynamic SplitFuse scheduler.
+
+Analog of InferenceEngineV2.can_schedule / the FastGen token-budget policy
+(inference/v2/engine_v2.py:184, blogs/deepspeed-fastgen): every engine step
+runs a fixed token budget; decoding sequences contribute 1 token each, the
+remaining budget is filled with prompt CHUNKS (long prompts are split across
+steps — "split"), and prompts co-run with decodes in one ragged batch
+("fuse").  Fixed-size steps keep forward latency flat and the MXU saturated.
+"""
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from .ragged_manager import RaggedStateManager, SequenceDescriptor
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledChunk:
+    uid: int
+    n_tokens: int  # tokens of this sequence to run this step
+
+
+class SplitFuseScheduler:
+
+    def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64):
+        self.token_budget = token_budget
+        self.max_seqs = max_seqs_per_step
+
+    def schedule(self, manager: RaggedStateManager) -> List[ScheduledChunk]:
+        """Pick this step's ragged batch. Decodes first (latency), then prompt
+        chunks to fill the budget; respects KV-pool availability."""
+        budget = self.token_budget
+        chunks: List[ScheduledChunk] = []
+        decoding, prefilling = [], []
+        for uid in manager.live_uids():
+            seq = manager.seqs[uid]
+            if seq.pending_tokens <= 0:
+                continue
+            (prefilling if seq.pending_tokens > 1 else decoding).append(seq)
+
+        for seq in decoding:
+            if budget <= 0 or len(chunks) >= self.max_seqs:
+                break
+            if not self._reserve(manager, seq, 1):
+                continue
+            chunks.append(ScheduledChunk(seq.uid, 1))
+            budget -= 1
+
+        for seq in prefilling:
+            if budget <= 0 or len(chunks) >= self.max_seqs:
+                break
+            take = min(seq.pending_tokens, budget)
+            while take > 0 and not self._reserve(manager, seq, take):
+                take //= 2  # shrink the chunk if the KV pool is tight
+            if take <= 0:
+                continue
+            chunks.append(ScheduledChunk(seq.uid, take))
+            budget -= take
+        return chunks
+
+    @staticmethod
+    def _reserve(manager: RaggedStateManager, seq: SequenceDescriptor, n: int) -> bool:
+        upto = seq.seen_tokens + n
+        if manager.over_cap(upto):
+            # fail just this sequence (reference: request rejection), not the step
+            manager.fail(seq.uid, f"needs {upto} tokens > "
+                         f"{manager.max_blocks_per_seq * manager.block_size} cap")
+            return False
+        need = manager.blocks_needed(seq, upto)
+        if need and not manager.can_allocate(need):
+            return False
+        manager.ensure_blocks(seq, upto)
+        return True

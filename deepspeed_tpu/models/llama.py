@@ -162,3 +162,179 @@ def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     n = num_params(config)
     attn = 12 * config.num_layers * config.hidden_size * seq_len  # qk+av fwd+bwd
     return 6.0 * n + attn
+
+
+# ------------------------------------------------------------------ inference
+def init_cache(config: LlamaConfig, batch: int, max_seq: Optional[int] = None, dtype=jnp.bfloat16):
+    """Dense KV cache pytree for incremental decoding: stacked per-layer
+    [L, B, S_max, KV, Dh] k/v buffers (the v1-engine analog of the reference's
+    inference_context workspace, csrc/transformer/inference/includes)."""
+    S = max_seq or config.max_seq_len
+    L, KV = config.num_layers, config.num_kv_heads
+    Dh = config.hidden_size // config.num_heads
+    return {
+        "k": jnp.zeros((L, batch, S, KV, Dh), dtype),
+        "v": jnp.zeros((L, batch, S, KV, Dh), dtype),
+        "len": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_with_cache(config: LlamaConfig, params, input_ids, cache, attention_fn=None):
+    """Incremental forward: consumes/extends the KV cache.
+
+    input_ids [B, S] (prompt at prefill, 1 token at decode); returns
+    (logits [B, S, V], new_cache).
+    """
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
+    b, s = input_ids.shape
+    start = cache["len"]
+    positions = start + jnp.arange(s)[None, :].repeat(b, axis=0)
+    x = params["embed"][input_ids].astype(cache["k"].dtype)
+
+    def layer(x, inp):
+        lp, kc, vc = inp
+        attn_in = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        attn_out, new_kv = attention_block(lp["attn"], attn_in,
+                                           n_heads=config.num_heads, n_kv_heads=config.num_kv_heads,
+                                           cos=cos, sin=sin, causal=True, attention_fn=attention_fn,
+                                           positions=positions, kv_cache=(kc, vc, start))
+        x = x + attn_out
+        mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        x = x + swiglu_mlp(lp["mlp"], mlp_in)
+        return x, (new_kv[0], new_kv[1])
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v, "len": start + s}
+
+
+def from_hf_state_dict(config: LlamaConfig, state_dict, dtype=jnp.float32):
+    """Convert a HuggingFace LlamaForCausalLM state dict to our params pytree
+    (the checkpoint-loading analog of module_inject/load_checkpoint.py).
+
+    torch Linear stores [out, in]; ours is [in, out] — transposed here.
+    """
+    import numpy as _np
+
+    def t(name):
+        w = state_dict[name]
+        w = w.float().numpy() if hasattr(w, "numpy") else _np.asarray(w, dtype=_np.float32)
+        return w
+
+    L = config.num_layers
+
+    def stack(fmt, transpose=True):
+        ws = [t(fmt.format(i)) for i in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(_np.stack(ws), dtype)
+
+    params = {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "attn": {
+                "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+                "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+                "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            },
+            "mlp": {
+                "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+                "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+                "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+            },
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+        },
+        "final_norm": jnp.asarray(t("model.norm.weight"), dtype),
+    }
+    if not config.tie_embeddings:
+        key = "lm_head.weight" if "lm_head.weight" in state_dict else "model.embed_tokens.weight"
+        params["lm_head"] = jnp.asarray(t(key).T, dtype)
+    return params
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """Build a LlamaConfig from a transformers LlamaConfig/MistralConfig."""
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", hf_config.num_attention_heads),
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 4096),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+
+
+# --------------------------------------------------------- paged (ragged) serve
+def init_paged_cache(config: LlamaConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    """Paged KV pool (reference inference/v2/ragged blocked KV layout):
+    [L, num_blocks, block_size, KV, Dh].  The last block is reserved as a trash
+    target for padded-token writes."""
+    L, KV = config.num_layers, config.num_kv_heads
+    Dh = config.hidden_size // config.num_heads
+    return {
+        "k": jnp.zeros((L, num_blocks, block_size, KV, Dh), dtype),
+        "v": jnp.zeros((L, num_blocks, block_size, KV, Dh), dtype),
+    }
+
+
+def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked forward over the paged KV pool (FastGen model-forward
+    analog, inference/v2/model_implementations/llama_v2 + blocked flash).
+
+    tokens [N, T] (right-padded chunks), n_tokens [N] valid counts,
+    start_pos [N] absolute start of this chunk, block_tables [N, MAXB]
+    (padded entries point at the trash block).  Returns (logits [N, T, V],
+    new kv_cache).
+    """
+    b, tchunk = tokens.shape
+    maxb = block_tables.shape[1]
+    trash = kv_cache["k"].shape[1] - 1
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
+    positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]  # [N, T]
+    valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
+    safe_pos = jnp.where(valid, positions, 0)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+
+    blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
+    blk = jnp.where(valid, blk, trash)
+    off = jnp.where(valid, safe_pos % block_size, 0)
+
+    kpos = jnp.arange(maxb * block_size)[None, None, :]  # [1, 1, MAXB*bs]
+    qpos = positions[:, :, None]  # [N, T, 1]
+    attn_mask = (kpos <= qpos) & valid[:, :, None]  # causal over absolute positions
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        attn_in = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = (attn_in @ lp["attn"]["wq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (attn_in @ lp["attn"]["wk"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        v = (attn_in @ lp["attn"]["wv"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        q = apply_rotary(q, cos, sin, safe_pos)
+        k = apply_rotary(k, cos, sin, safe_pos)
+        kpool = kpool.at[blk, off].set(k)
+        vpool = vpool.at[blk, off].set(v)
+        # gather each sequence's context blocks -> [N, MAXB*bs, KV, Dh]
+        ctx_k = kpool[block_tables].reshape(b, maxb * block_size, KV, Dh)
+        ctx_v = vpool[block_tables].reshape(b, maxb * block_size, KV, Dh)
+        out = sdpa(q, ctx_k, ctx_v, causal=False, mask=attn_mask[:, None, :, :], softmax_scale=scale)
+        x = x + out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype)
+        mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        x = x + swiglu_mlp(lp["mlp"], mlp_in)
+        return x, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

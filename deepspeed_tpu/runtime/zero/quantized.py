@@ -26,7 +26,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...ops.quantizer.quantize import (dequantize_int8, quantize_int8, quantized_psum_scatter_int4)
+from ...ops.quantizer.quantize import (quantized_allgather_int8, quantized_psum_scatter_int4)
 from ..grad_accum import accumulate_micro_grads
 
 # Leaves smaller than this reduce in fp32 (quantization overhead not worth it —
@@ -110,12 +110,9 @@ def qwz_cast_gather(master, mesh, dp_axes: Sequence[str], compute_dtype, group_s
             return x.astype(compute_dtype)
 
         def local(shard):
-            flat = shard.reshape(-1)
-            q, s, nn = quantize_int8(flat, group_size)
-            q_all = jax.lax.all_gather(q, axis_name)
-            s_all = jax.lax.all_gather(s, axis_name)
-            deq = jax.vmap(lambda qq, ss: dequantize_int8(qq, ss, nn, dtype=compute_dtype))(q_all, s_all)
-            return deq.reshape(-1)
+            gathered = quantized_allgather_int8(shard.reshape(-1).astype(compute_dtype),
+                                                axis_name, group_size)
+            return gathered.reshape(-1)
 
         # ask the sharding plan which dim the master leaf is actually sharded on
         # so the explicit gather matches the stored layout (no extra reshard)
