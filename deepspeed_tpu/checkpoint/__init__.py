@@ -1,0 +1,2 @@
+"""Checkpoint conversion tools (reference deepspeed/checkpoint/)."""
+from .universal import ds_to_universal, load_universal, zero_to_fp32

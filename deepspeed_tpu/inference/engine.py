@@ -24,10 +24,7 @@ from ..parallel.mesh import MeshTopology, TENSOR_AXIS
 from ..runtime.zero.sharding import ShardingPlan
 from ..utils.logging import log_dist
 from .auto_tp import auto_tp_rules
-from .config import InferenceConfig, load_inference_config
-
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
-
+from .config import DTYPES as _DTYPES, InferenceConfig, load_inference_config
 
 class InferenceEngine:
     """Serve a model-family module (models.llama-style: needs forward_with_cache
@@ -127,6 +124,8 @@ class InferenceEngine:
         ids = jnp.asarray(np.asarray(input_ids))
         b, s = ids.shape
         new = max_new_tokens if max_new_tokens is not None else self.config.max_out_tokens
+        if new <= 0:
+            return np.asarray(ids)
         temperature = self.config.temperature if temperature is None else temperature
         top_k = self.config.top_k if top_k is None else top_k
         top_p = self.config.top_p if top_p is None else top_p

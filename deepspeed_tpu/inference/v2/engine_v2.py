@@ -18,12 +18,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils.logging import log_dist
-from ..config import load_inference_config
+from ..config import DTYPES as _DTYPES, load_inference_config
 from .ragged_manager import RaggedStateManager
 from .scheduler import ScheduledChunk, SplitFuseScheduler
-
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
-
 
 class InferenceEngineV2:
 
@@ -112,8 +109,11 @@ class InferenceEngineV2:
                 if greedy:
                     tok = int(np.argmax(last_logits[i]))
                 else:
-                    self._rng, sub = jax.random.split(self._rng)
-                    tok = int(jax.random.categorical(sub, jnp.asarray(last_logits[i])))
+                    from ..engine import _sample
+                    toks, self._rng = _sample(jnp.asarray(last_logits[i:i + 1]), self._rng,
+                                              temperature=self.config.temperature,
+                                              top_k=self.config.top_k, top_p=self.config.top_p)
+                    tok = int(toks[0])
                 seq.tokens.append(tok)
                 out[c.uid] = tok
         return out

@@ -68,6 +68,8 @@ class RaggedStateManager:
         seq = self.seqs.get(uid)
         if seq is not None:
             seq.done = True
+            self.allocator.free(seq.blocks)  # reclaim the KV pool immediately
+            seq.blocks = []
 
     def can_allocate(self, n_blocks: int) -> bool:
         return self.allocator.free_blocks >= n_blocks

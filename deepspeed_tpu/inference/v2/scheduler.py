@@ -50,9 +50,9 @@ class SplitFuseScheduler:
             if budget <= 0 or len(chunks) >= self.max_seqs:
                 break
             take = min(seq.pending_tokens, budget)
-            while take > 0 and not self._reserve(manager, seq, take):
+            while take > 0 and not seq.done and not self._reserve(manager, seq, take):
                 take //= 2  # shrink the chunk if the KV pool is tight
-            if take <= 0:
+            if take <= 0 or seq.done:
                 continue
             chunks.append(ScheduledChunk(seq.uid, take))
             budget -= take

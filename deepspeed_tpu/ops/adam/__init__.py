@@ -1,2 +1,3 @@
 """Fused optimizer kernels (reference csrc/adam multi_tensor_adam analog)."""
 from .fused_adam import fused_adamw_flat, fused_lion_flat
+from .cpu_adam import DeepSpeedCPUAdam

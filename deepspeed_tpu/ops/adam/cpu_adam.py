@@ -1,0 +1,55 @@
+"""Host-resident AdamW (reference DeepSpeedCPUAdam, deepspeed/ops/adam/cpu_adam.py).
+
+Steps fp32 master params + moments in host RAM via the OpenMP/SIMD C++ kernel
+(csrc/cpu_adam/cpu_adam.cpp); numpy fallback keeps identical math when no
+compiler is present.  Used by the optimizer-offload path (runtime/swap_tensor).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+from ..op_builder import CPUAdamBuilder
+
+
+class DeepSpeedCPUAdam:
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self._lib = None
+        try:
+            self._lib = CPUAdamBuilder().load()
+        except Exception as exc:
+            logger.warning(f"native cpu_adam unavailable ({exc}); using numpy fallback")
+
+    def step(self, p: np.ndarray, m: np.ndarray, v: np.ndarray, g: np.ndarray,
+             lr: Optional[float] = None, step: Optional[int] = None) -> None:
+        """In-place AdamW on flat fp32 host buffers."""
+        lr = self.lr if lr is None else float(lr)
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        assert p.dtype == np.float32 and p.flags["C_CONTIGUOUS"]
+        g32 = np.ascontiguousarray(g, dtype=np.float32)
+        if self._lib is not None:
+            import ctypes
+            f32p = ctypes.POINTER(ctypes.c_float)
+            self._lib.dstpu_adamw_step(p.ctypes.data_as(f32p), m.ctypes.data_as(f32p),
+                                       v.ctypes.data_as(f32p), g32.ctypes.data_as(f32p),
+                                       p.size, lr, self.beta1, self.beta2, self.eps,
+                                       self.weight_decay, step)
+            return
+        # numpy fallback — identical math
+        np.multiply(m, self.beta1, out=m)
+        m += (1 - self.beta1) * g32
+        np.multiply(v, self.beta2, out=v)
+        v += (1 - self.beta2) * g32 * g32
+        bc1 = 1 - self.beta1**step
+        bc2 = 1 - self.beta2**step
+        update = (m / bc1) / (np.sqrt(v / bc2) + self.eps) + self.weight_decay * p
+        p -= lr * update

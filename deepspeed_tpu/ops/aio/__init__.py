@@ -1,0 +1,110 @@
+"""Async file I/O for tensor offload (reference deepspeed/ops/aio + csrc/aio).
+
+``build_aio_handle()`` returns the native threaded pread/pwrite library when a
+C++ toolchain is available, else a Python thread-pool fallback with the same
+interface: submit pwrite/pread -> request id; wait(id) -> byte count; wait_all().
+"""
+
+import ctypes
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict
+
+import numpy as np
+
+from ...utils.logging import logger
+from ..op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    """ctypes wrapper over the native aio library."""
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.dstpu_aio_open(num_threads)
+
+    def pwrite(self, path: str, arr: np.ndarray) -> int:
+        arr = np.ascontiguousarray(arr)
+        self._keepalive = getattr(self, "_keepalive", {})
+        rid = self._lib.dstpu_aio_pwrite(self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                                         arr.nbytes)
+        self._keepalive[rid] = arr  # pin until waited
+        return rid
+
+    def pread(self, path: str, arr: np.ndarray) -> int:
+        assert arr.flags["C_CONTIGUOUS"] and arr.flags["WRITEABLE"]
+        rid = self._lib.dstpu_aio_pread(self._h, path.encode(), arr.ctypes.data_as(ctypes.c_void_p),
+                                        arr.nbytes)
+        self._keepalive = getattr(self, "_keepalive", {})
+        self._keepalive[rid] = arr
+        return rid
+
+    def wait(self, rid: int) -> int:
+        out = int(self._lib.dstpu_aio_wait(self._h, rid))
+        self._keepalive.pop(rid, None)
+        if out < 0:
+            raise OSError(-out, os.strerror(-out))
+        return out
+
+    def wait_all(self) -> None:
+        failures = self._lib.dstpu_aio_wait_all(self._h)
+        self._keepalive = {}
+        if failures:
+            raise OSError(f"{failures} async IO requests failed")
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.dstpu_aio_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PyAsyncIOHandle:
+    """Pure-Python fallback (ThreadPoolExecutor) with the same surface."""
+
+    def __init__(self, num_threads: int = 4):
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self._futs: Dict[int, object] = {}
+        self._next = 1
+
+    def _submit(self, fn) -> int:
+        rid = self._next
+        self._next += 1
+        self._futs[rid] = self._pool.submit(fn)
+        return rid
+
+    def pwrite(self, path: str, arr: np.ndarray) -> int:
+        data = np.ascontiguousarray(arr)
+        return self._submit(lambda: open(path, "wb").write(data.tobytes()))
+
+    def pread(self, path: str, arr: np.ndarray) -> int:
+        def read():
+            with open(path, "rb") as fh:
+                buf = fh.read(arr.nbytes)
+            arr.view(np.uint8).reshape(-1)[:len(buf)] = np.frombuffer(buf, np.uint8)
+            return len(buf)
+
+        return self._submit(read)
+
+    def wait(self, rid: int) -> int:
+        return int(self._futs.pop(rid).result())
+
+    def wait_all(self) -> None:
+        for rid in list(self._futs):
+            self.wait(rid)
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+
+
+def build_aio_handle(num_threads: int = 4):
+    try:
+        return AsyncIOHandle(num_threads)
+    except Exception as exc:  # no compiler / build failure
+        logger.warning(f"native aio unavailable ({exc}); using Python thread-pool fallback")
+        return PyAsyncIOHandle(num_threads)

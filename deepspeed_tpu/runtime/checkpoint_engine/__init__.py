@@ -1,0 +1,3 @@
+"""Checkpoint engine plug-ins (reference runtime/checkpoint_engine/)."""
+from .checkpoint_engine import (AsyncCheckpointEngine, CheckpointEngine, NativeCheckpointEngine,
+                                build_checkpoint_engine)

@@ -1,0 +1,97 @@
+"""Checkpoint engine plug-ins.
+
+Analog of the reference's checkpoint-engine abstraction
+(runtime/checkpoint_engine/checkpoint_engine.py — CheckpointEngine ABC,
+TorchCheckpointEngine, async NebulaCheckpointEngine:20): an engine owns how
+leaf arrays get persisted.  The native engine writes .npy files; the async
+engine stages host copies and writes on a background thread so the train loop
+isn't blocked on disk (the Nebula tier-1 behavior).
+"""
+
+import os
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    """Persistence strategy for checkpoint leaves."""
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def save(self, arr: np.ndarray, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str) -> np.ndarray:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Flush everything for ``tag``; returns True when durable."""
+        return True
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    """Synchronous .npy writer (TorchCheckpointEngine analog)."""
+
+    def save(self, arr: np.ndarray, path: str) -> None:
+        np.save(path, arr)
+
+    def load(self, path: str) -> np.ndarray:
+        return np.load(path)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread writer (NebulaCheckpointEngine analog): save() enqueues
+    an already-host-resident array and returns immediately; commit() drains the
+    queue.  One writer thread preserves write order."""
+
+    def __init__(self, max_queue: int = 64):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            arr, path = item
+            try:
+                np.save(path, arr)
+            except BaseException as exc:  # surfaced at commit()
+                self._error = exc
+            finally:
+                self._queue.task_done()
+
+    def save(self, arr: np.ndarray, path: str) -> None:
+        if self._error is not None:
+            raise RuntimeError(f"async checkpoint writer failed: {self._error}")
+        self._queue.put((np.asarray(arr), path))
+
+    def load(self, path: str) -> np.ndarray:
+        return np.load(path)
+
+    def commit(self, tag: str) -> bool:
+        self._queue.join()
+        if self._error is not None:
+            raise RuntimeError(f"async checkpoint writer failed: {self._error}")
+        return True
+
+    def close(self):
+        self._queue.join()
+        self._queue.put(None)
+        self._thread.join()
+
+
+def build_checkpoint_engine(kind: str = "native") -> CheckpointEngine:
+    if kind in ("native", "torch"):
+        return NativeCheckpointEngine()
+    if kind in ("async", "nebula"):
+        return AsyncCheckpointEngine()
+    raise ValueError(f"unknown checkpoint engine '{kind}' (native|async)")

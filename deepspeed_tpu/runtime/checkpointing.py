@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..utils.logging import log_dist, logger
+from .checkpoint_engine import CheckpointEngine, NativeCheckpointEngine
 
 LATEST_FILE = "latest"
 
@@ -50,19 +51,22 @@ def _is_rank0() -> bool:
         return True
 
 
-def save_checkpoint_dir(save_dir: str, tag: str, state, client_state: Dict, config=None):
+def save_checkpoint_dir(save_dir: str, tag: str, state, client_state: Dict, config=None,
+                        engine: Optional[CheckpointEngine] = None):
     """Write the full state under ``save_dir/tag/`` and update ``latest``."""
+    engine = engine or NativeCheckpointEngine()
     ckpt_dir = os.path.join(save_dir, tag)
     if _is_rank0():
-        os.makedirs(ckpt_dir, exist_ok=True)
+        engine.makedirs(ckpt_dir)
     leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
     manifest = []
     for path, leaf in leaves_with_path:
         key = _leaf_key(path)
         arr = _gather_to_host(leaf)
         if _is_rank0():
-            np.save(os.path.join(ckpt_dir, key + ".npy"), arr)
+            engine.save(arr, os.path.join(ckpt_dir, key + ".npy"))
         manifest.append({"key": key, "shape": list(arr.shape), "dtype": str(arr.dtype)})
+    engine.commit(tag)
     if _is_rank0():
         meta = {"manifest": manifest, "client_state": _jsonable(client_state)}
         with open(os.path.join(ckpt_dir, "metadata.json"), "w") as fh:

@@ -101,7 +101,13 @@ class Engine:
         self._compiled_step = None
         self._compiled_eval = None
 
-        self.state = self._init_state(params)
+        off = config.zero_optimization.offload_optimizer
+        self.offload_device = off.device if (off is not None and off.device != "none") else None
+        if self.offload_device is not None:
+            self._init_offload(params, off)
+            self.state = None
+        else:
+            self.state = self._init_state(params)
         n_params = sum(int(np.prod(np.shape(p))) for p in jax.tree_util.tree_leaves(params))
         log_dist(
             f"Engine: zero_stage={self.zero_stage} dp_world={self.dp_world_size} "
@@ -139,6 +145,77 @@ class Engine:
             loss_scale=jax.tree_util.tree_map(lambda _: rep, state_shapes.loss_scale),
             rng=rep,
         )
+
+    # ------------------------------------------------- optimizer offload path
+    def _init_offload(self, params, off_cfg):
+        """ZeRO-Offload/Infinity analog (reference swap_tensor + cpu_adam): fp32
+        master + Adam moments live on host (cpu) or disk (nvme); the device
+        holds only the bf16 compute copy.  The jitted program computes grads;
+        the C++ cpu_adam steps host buffers."""
+        from .swap_tensor.optimizer_swapper import OffloadedAdamState
+        if self.fp16_enabled:
+            raise ValueError("optimizer offload requires bf16/fp32 (fp16 dynamic loss "
+                             "scaling is not supported on the host-offload path)")
+        opt_cfg = self.config.optimizer
+        opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
+        if opt_type not in ("adam", "adamw"):
+            raise ValueError(f"optimizer offload supports adam/adamw, got '{opt_type}'")
+        opt_params = dict(opt_cfg.params) if opt_cfg else {}
+        flat, self._offload_treedef = jax.tree_util.tree_flatten_with_path(params)
+        self._offload_keys = []
+        self._offload_shapes = []
+        flat_dict = {}
+        for path, leaf in flat:
+            key = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            self._offload_keys.append(key)
+            self._offload_shapes.append(np.shape(leaf))
+            flat_dict[key] = np.asarray(leaf, np.float32).ravel()
+        betas = tuple(opt_params.get("betas", (0.9, 0.999)))
+        self._offload_state = OffloadedAdamState(
+            flat_dict, device=self.offload_device,
+            nvme_path=getattr(off_cfg, "nvme_path", None),
+            lr=self.base_lr, betas=betas,
+            eps=float(opt_params.get("eps", 1e-8)),
+            weight_decay=float(opt_params.get("weight_decay", 0.0)))
+        self._push_compute_params()
+        self._offload_grad_fn = None
+        self._host_rng = jax.random.PRNGKey(self.config.seed)
+
+    def _push_compute_params(self):
+        leaves = [jnp.asarray(self._offload_state.params[k].reshape(shape), self.compute_dtype)
+                  for k, shape in zip(self._offload_keys, self._offload_shapes)]
+        tree = jax.tree_util.tree_unflatten(self._offload_treedef, leaves)
+        shardings = self.plan.param_shardings(tree)
+        self._compute_params = jax.jit(lambda p: p, out_shardings=shardings)(tree)
+
+    def _offload_train_batch(self, batch):
+        gas = self.gradient_accumulation_steps
+        if self._offload_grad_fn is None:
+            loss_fn = self.loss_fn
+            clip_norm = self.config.gradient_clipping
+
+            def grad_step(params16, batch, rngs):
+                grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, rngs,
+                                                         jnp.float32(1.0))
+                grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+                norm = global_grad_norm(grads)
+                if clip_norm > 0:
+                    grads, norm = clip_by_global_norm(grads, clip_norm, precomputed_norm=norm)
+                return grads, loss_sum / gas, norm
+
+            self._offload_grad_fn = jax.jit(grad_step)
+
+        self._host_rng, step_rng = jax.random.split(self._host_rng)
+        rngs = jax.random.split(step_rng, gas)
+        grads, loss, norm = self._offload_grad_fn(self._compute_params, batch, rngs)
+        grad_leaves = jax.tree_util.tree_leaves(grads)
+        grads_np = {k: np.asarray(g, np.float32).ravel()
+                    for k, g in zip(self._offload_keys, grad_leaves)}
+        lr = float(self.lr_schedule(jnp.int32(self.global_steps)))
+        self._offload_state.step(grads_np, lr=lr)
+        self._push_compute_params()
+        return StepMetrics(loss=loss, grad_norm=norm, lr=jnp.float32(lr),
+                           skipped=jnp.zeros((), jnp.bool_), loss_scale=jnp.float32(1.0))
 
     # ------------------------------------------------------------- train step
     def _build_train_step(self):
@@ -278,7 +355,10 @@ class Engine:
         batch = self._ensure_gas_layout(batch)
         batch = self._shard_batch(batch)
         self.throughput.start()
-        self.state, metrics = self.train_step_fn(self.state, batch)
+        if self.offload_device is not None:
+            metrics = self._offload_train_batch(batch)
+        else:
+            self.state, metrics = self.train_step_fn(self.state, batch)
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self.lr_scheduler.last_step = self.global_steps
@@ -320,8 +400,8 @@ class Engine:
         if self._compiled_eval is None:
             compute_dtype = self.compute_dtype
 
-            def eval_step(state, b, rng):
-                p16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), state.params)
+            def eval_step(params, b, rng):
+                p16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
                 out = self.loss_fn(p16, b, rng)
                 return out[0] if isinstance(out, tuple) else out
 
@@ -331,7 +411,8 @@ class Engine:
                                  PartitionSpec(self.plan.shard_axes if len(self.plan.shard_axes) > 1 else
                                                self.plan.shard_axes[0]))
         batch = jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
-        return self._compiled_eval(self.state, batch, rng)
+        params = self._compute_params if self.offload_device is not None else self.state.params
+        return self._compiled_eval(params, batch, rng)
 
     # ----------------------------------------------------------- reporting
     def _maybe_report(self, metrics: StepMetrics):
@@ -363,10 +444,27 @@ class Engine:
             "global_samples": self.global_samples,
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
-        save_checkpoint_dir(save_dir, tag, self.state, client_state, config=self.config)
+        state = self.state if self.offload_device is None else self._offload_host_state()
+        save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config)
         return tag
 
+    def _offload_host_state(self):
+        """Host-side state pytree with the SAME key layout as the on-device
+        TrainState, so checkpoints and the universal converter are identical
+        across offload modes."""
+        unflatten = lambda arrs: jax.tree_util.tree_unflatten(
+            self._offload_treedef,
+            [a.reshape(shape) for a, shape in zip(arrs, self._offload_shapes)])
+        sd = self._offload_state.state_dict()
+        params = unflatten([self._offload_state.params[k] for k in self._offload_keys])
+        m = unflatten([sd["m"][k] for k in self._offload_keys])
+        v = unflatten([sd["v"][k] for k in self._offload_keys])
+        return {"step": np.int32(sd["step"]), "params": params,
+                "opt_state": {"exp_avg": m, "exp_avg_sq": v}}
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
+        if self.offload_device is not None:
+            return self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
         state, client_state = load_checkpoint_dir(load_dir,
                                                  tag,
                                                  self.state,
@@ -379,10 +477,41 @@ class Engine:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
         return tag, client_state
 
+    def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True):
+        from .checkpointing import get_latest_tag
+        import json as _json
+        tag = tag or get_latest_tag(load_dir)
+        ckpt_dir = os.path.join(load_dir, tag)
+        with open(os.path.join(ckpt_dir, "metadata.json")) as fh:
+            meta = _json.load(fh)
+        sd = {"m": {}, "v": {}, "step": 0}
+        for m in meta["manifest"]:
+            key = m["key"]
+            path = os.path.join(ckpt_dir, key + ".npy")
+            if key.startswith("params."):
+                self._offload_state.params[key[len("params."):]][...] = np.load(path).ravel()
+            elif key.startswith("opt_state.exp_avg_sq.") and load_optimizer_states:
+                sd["v"][key[len("opt_state.exp_avg_sq."):]] = np.load(path).ravel()
+            elif key.startswith("opt_state.exp_avg.") and load_optimizer_states:
+                sd["m"][key[len("opt_state.exp_avg."):]] = np.load(path).ravel()
+            elif key in ("step", "opt_state.step"):
+                sd["step"] = int(np.load(path))
+        if load_optimizer_states and sd["m"]:
+            self._offload_state.load_state_dict(sd)
+        self._push_compute_params()
+        client_state = meta.get("client_state", {})
+        self.global_steps = client_state.get("global_steps", 0)
+        self.global_samples = client_state.get("global_samples", 0)
+        if "lr_scheduler" in client_state:
+            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return tag, client_state
+
     # ------------------------------------------------------------- utilities
     def get_fp32_params(self):
         """Gather the full fp32 master params on host — the analog of
         zero_to_fp32 consolidation (deepspeed/utils/zero_to_fp32.py)."""
+        if self.offload_device is not None:
+            return self._offload_host_state()["params"]
         rep = NamedSharding(self.topology.mesh, PartitionSpec())
         gathered = jax.jit(lambda p: p, out_shardings=jax.tree_util.tree_map(lambda _: rep, self.state.params))(
             self.state.params)

@@ -1,0 +1,2 @@
+"""Tensor offload/swap machinery (reference runtime/swap_tensor/)."""
+from .optimizer_swapper import OffloadedAdamState
