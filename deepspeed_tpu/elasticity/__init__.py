@@ -1,0 +1,3 @@
+"""Elastic training (reference deepspeed/elasticity/)."""
+from .elasticity import (ElasticityConfig, compute_elastic_config, get_best_candidates,
+                         get_valid_gpus)

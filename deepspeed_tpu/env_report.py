@@ -1,0 +1,83 @@
+"""Environment/compatibility report CLI — the ds_report analog
+(reference deepspeed/env_report.py, bin/ds_report): shows framework, JAX/TPU
+runtime, device inventory, and native-op build status.
+"""
+
+import importlib
+import platform
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return "not installed"
+
+
+def op_report() -> list:
+    """Native-op compatibility matrix (reference op compatibility table)."""
+    from .ops.op_builder import AsyncIOBuilder, CPUAdamBuilder
+    rows = []
+    for builder in (AsyncIOBuilder(), CPUAdamBuilder()):
+        compatible = builder.is_compatible()
+        built = False
+        if compatible:
+            try:
+                builder.load()
+                built = True
+            except Exception:
+                built = False
+        rows.append((builder.name, compatible, built))
+    return rows
+
+
+def pallas_report() -> list:
+    """Pallas kernel availability (flash attention, fused optimizers, quantizer)."""
+    rows = []
+    for name, mod in (("flash_attention", "deepspeed_tpu.ops.attention.flash"),
+                      ("fused_adam", "deepspeed_tpu.ops.adam.fused_adam"),
+                      ("quantizer", "deepspeed_tpu.ops.quantizer.quantize")):
+        try:
+            importlib.import_module(mod)
+            rows.append((name, True))
+        except Exception:
+            rows.append((name, False))
+    return rows
+
+
+def main(argv=None):
+    import deepspeed_tpu
+    print("-" * 70)
+    print("DeepSpeed-TPU C++/Pallas op report")
+    print("-" * 70)
+    for name, compatible, built in op_report():
+        mark = GREEN_OK if built else RED_NO
+        print(f"{name:<24} compatible={str(compatible):<6} built ... {mark}")
+    for name, ok in pallas_report():
+        print(f"{name:<24} pallas kernel ............ {GREEN_OK if ok else RED_NO}")
+    print("-" * 70)
+    print("General environment:")
+    print(f"  python ................ {platform.python_version()}")
+    print(f"  platform .............. {platform.platform()}")
+    print(f"  deepspeed_tpu ......... {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "transformers"):
+        print(f"  {mod:<20} {_try_version(mod)}")
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"  jax backend ........... {jax.default_backend()}")
+        print(f"  devices ............... {len(devs)} x {devs[0].device_kind if devs else 'none'}")
+    except Exception as exc:
+        print(f"  jax devices ........... unavailable ({exc})")
+    print("-" * 70)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Launcher / cluster tooling (reference deepspeed/launcher/)."""
+from .runner import (MultiNodeRunner, PDSHRunner, SSHRunner, encode_world_info, decode_world_info,
+                     fetch_hostfile, parse_inclusion_exclusion)

@@ -1,0 +1,38 @@
+"""Per-node launch entry (reference launcher/launch.py:main:132).
+
+The reference forks one process per local GPU with RANK/LOCAL_RANK env; on TPU
+the JAX runtime owns all local chips from ONE process, so this entry resolves
+the node's PROCESS_ID from the world info, exports the jax.distributed
+coordination env, and execs the user script in-process.
+"""
+
+import os
+import runpy
+import socket
+import sys
+
+from ..utils.logging import logger
+from .runner import decode_world_info
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        raise SystemExit("usage: python -m deepspeed_tpu.launcher.launch <script> [args...]")
+    world = decode_world_info(os.environ.get("DSTPU_WORLD_INFO", "e30="))
+    if "PROCESS_ID" not in os.environ and world:
+        hostname = socket.gethostname()
+        hosts = list(world)
+        matches = [i for i, h in enumerate(hosts) if h in (hostname, hostname.split(".")[0])]
+        if matches:
+            os.environ["PROCESS_ID"] = str(matches[0])
+        else:
+            logger.warning(f"host {hostname} not in world info {hosts}; defaulting PROCESS_ID=0")
+            os.environ.setdefault("PROCESS_ID", "0")
+    script, args = argv[0], argv[1:]
+    sys.argv = [script] + list(args)
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

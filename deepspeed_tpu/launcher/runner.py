@@ -1,0 +1,220 @@
+"""Cluster launcher CLI.
+
+Analog of the reference launcher (deepspeed/launcher/runner.py:main:388 +
+launch.py, bin/deepspeed): parses a hostfile, filters resources with
+--include/--exclude, encodes the world info, and launches the training script.
+
+TPU-native topology: ONE process per HOST (the JAX runtime owns all local
+chips — unlike the reference's one-process-per-GPU fork), with
+``jax.distributed.initialize`` coordinated through env vars
+(COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID).  Multi-node runners mirror the
+reference's MultiNodeRunner hierarchy (multinode_runner.py:18-375) with pdsh
+and ssh backends; single-node just execs locally.
+"""
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DEFAULT_SSH_PORT = 22
+
+
+def fetch_hostfile(path: str) -> Dict[str, int]:
+    """Parse 'hostname slots=N' lines (reference runner.fetch_hostfile:200)."""
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hostfile {path} not found")
+    resources: Dict[str, int] = {}
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    try:
+                        slots = int(p[len("slots="):])
+                    except ValueError:
+                        raise ValueError(f"{path}:{lineno}: bad slots in {line!r}")
+            if host in resources:
+                raise ValueError(f"{path}:{lineno}: duplicate host {host}")
+            resources[host] = slots
+    if not resources:
+        raise ValueError(f"hostfile {path} is empty")
+    return resources
+
+
+def parse_inclusion_exclusion(resources: Dict[str, int], include: str = "",
+                              exclude: str = "") -> Dict[str, int]:
+    """--include/--exclude 'host1@host2:0,2' filtering (reference :255).
+
+    For TPU hosts the per-host slot selection selects CHIP COUNT, not device
+    ids (the JAX runtime claims local chips as one process)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    def parse_spec(spec: str) -> Dict[str, Optional[List[int]]]:
+        out: Dict[str, Optional[List[int]]] = {}
+        for part in spec.split("@"):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                host, ids = part.split(":")
+                out[host] = [int(i) for i in ids.split(",")]
+            else:
+                out[part] = None
+        return out
+
+    if include:
+        spec = parse_spec(include)
+        filtered = {}
+        for host, ids in spec.items():
+            if host not in resources:
+                raise ValueError(f"--include host {host} not in hostfile")
+            filtered[host] = len(ids) if ids is not None else resources[host]
+        return filtered
+    if exclude:
+        spec = parse_spec(exclude)
+        filtered = dict(resources)
+        for host, ids in spec.items():
+            if host not in filtered:
+                raise ValueError(f"--exclude host {host} not in hostfile")
+            if ids is None:
+                del filtered[host]
+            else:
+                filtered[host] = max(0, filtered[host] - len(ids))
+        return {h: s for h, s in filtered.items() if s > 0}
+    return dict(resources)
+
+
+def encode_world_info(resources: Dict[str, int]) -> str:
+    """base64 world info env payload (reference runner.py:353)."""
+    return base64.urlsafe_b64encode(json.dumps(resources).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, int]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+class MultiNodeRunner:
+    """Base remote runner (reference multinode_runner.py:18)."""
+    name = "base"
+
+    def __init__(self, args, world_info: Dict[str, int]):
+        self.args = args
+        self.world_info = world_info
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError
+
+    def get_cmd(self, environment: Dict[str, str], active_resources: Dict[str, int]) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def user_arguments(self) -> List[str]:
+        return [self.args.user_script] + list(self.args.user_args)
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out (reference multinode_runner.py:51)."""
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        env_exports = [f"export {k}={v};" for k, v in sorted(environment.items())]
+        hosts = ",".join(active_resources.keys())
+        remote_cmd = " ".join(env_exports + [sys.executable, "-u", "-m",
+                                             "deepspeed_tpu.launcher.launch"] + self.user_arguments)
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote_cmd]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain-ssh loop fallback when pdsh is absent."""
+    name = "ssh"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmds(self, environment, active_resources):
+        cmds = []
+        for rank, host in enumerate(active_resources):
+            env = dict(environment, PROCESS_ID=str(rank))
+            exports = [f"export {k}={v};" for k, v in sorted(env.items())]
+            remote = " ".join(exports + [sys.executable, "-u", "-m",
+                                         "deepspeed_tpu.launcher.launch"] + self.user_arguments)
+            cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host, remote])
+        return cmds
+
+    def get_cmd(self, environment, active_resources):
+        return self.get_cmds(environment, active_resources)[0]
+
+
+def build_launch_env(resources: Dict[str, int], master_addr: str, master_port: int) -> Dict[str, str]:
+    return {
+        "DSTPU_WORLD_INFO": encode_world_info(resources),
+        "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        "NUM_PROCESSES": str(len(resources)),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher (reference bin/deepspeed)")
+    parser.add_argument("-H", "--hostfile", default="/job/hostfile")
+    parser.add_argument("--include", default="")
+    parser.add_argument("--exclude", default="")
+    parser.add_argument("--master_addr", default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", default="pdsh", choices=("pdsh", "ssh", "local"))
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    multi_node = os.path.isfile(args.hostfile) or args.force_multi
+    if not multi_node:
+        logger.info("no hostfile: launching locally (single host, all local chips)")
+        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        return subprocess.call(cmd)
+
+    resources = fetch_hostfile(args.hostfile)
+    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    master_addr = args.master_addr or next(iter(resources))
+    env = build_launch_env(resources, master_addr, args.master_port)
+
+    runner: MultiNodeRunner
+    if args.launcher == "pdsh":
+        runner = PDSHRunner(args, resources)
+        if not runner.backend_exists():
+            logger.warning("pdsh not found; falling back to ssh")
+            runner = SSHRunner(args, resources)
+    else:
+        runner = SSHRunner(args, resources)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{runner.name}' not available")
+
+    if isinstance(runner, SSHRunner):
+        procs = [subprocess.Popen(c) for c in runner.get_cmds(env, resources)]
+        rc = 0
+        for p in procs:
+            rc |= p.wait()
+        return rc
+    cmd = runner.get_cmd(env, resources)
+    logger.info(f"launching: {' '.join(cmd)}")
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

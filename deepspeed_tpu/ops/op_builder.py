@@ -66,15 +66,16 @@ class OpBuilder:
         if cc is None:
             raise RuntimeError(f"no C++ compiler found for op '{self.name}'")
         os.makedirs(DEFAULT_BUILD_DIR, exist_ok=True)
+        tmp = f"{out}.{os.getpid()}.tmp"  # unique per process; os.replace is atomic
         cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native", "-fopenmp",
-               *self.extra_cxx_flags, *self.abs_sources(), "-o", out + ".tmp",
+               *self.extra_cxx_flags, *self.abs_sources(), "-o", tmp,
                "-lpthread", *self.extra_ld_flags]
         logger.info(f"building native op '{self.name}': {' '.join(cmd)}")
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
         except subprocess.CalledProcessError as exc:
             raise RuntimeError(f"native build of '{self.name}' failed:\n{exc.stderr}") from exc
-        os.replace(out + ".tmp", out)
+        os.replace(tmp, out)
         return out
 
     def load(self) -> ctypes.CDLL:

@@ -1,0 +1,2 @@
+"""Profiling tools (reference deepspeed/profiling/)."""
+from .flops_profiler import FlopsProfiler, ProfileResult, get_model_profile, profile_fn

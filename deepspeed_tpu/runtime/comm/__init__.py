@@ -1,0 +1,2 @@
+"""Compressed communication backends (reference deepspeed/runtime/comm/)."""
+from .compressed import compress_signs, onebit_allreduce, onebit_allreduce_tree

@@ -1,0 +1,5 @@
+"""Data-efficiency pipeline (reference runtime/data_pipeline/)."""
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from .random_ltd import (RandomLTDScheduler, gather_tokens, random_ltd_layer, sample_token_indices,
+                         scatter_tokens)

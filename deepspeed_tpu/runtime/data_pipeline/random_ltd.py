@@ -1,0 +1,67 @@
+"""Random layerwise token dropping (random-LTD).
+
+Analog of the reference random-LTD (runtime/data_pipeline/data_routing/
+basic_layer.py + scheduler.py:38, csrc/random_ltd token_sort/gather kernels):
+middle layers process a random SUBSET of tokens; dropped tokens bypass the
+layer and are scattered back, cutting attention cost quadratically while the
+kept-token budget ramps up on a schedule.  The CUDA token_sort/gather kernels
+become jnp.take/scatter (XLA fuses the gathers).
+"""
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Token-budget ramp (reference scheduler.py:38): linear increase of kept
+    tokens from min_value to max_value over schedule steps."""
+
+    def __init__(self, config: Dict):
+        ltd = config.get("random_ltd", config)
+        self.min_tokens = int(ltd.get("random_ltd_schedule", {}).get("min_value", ltd.get("min_value", 128)))
+        self.max_tokens = int(ltd.get("random_ltd_schedule", {}).get("max_value", ltd.get("max_value", 512)))
+        sched = ltd.get("random_ltd_schedule", ltd)
+        self.step_size = int(sched.get("schedule_config", sched).get("seq_per_step", 16))
+        self.total_steps = int(sched.get("schedule_config", sched).get("require_steps", 1000))
+        self.current_tokens = self.min_tokens
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(self.total_steps, 1))
+        tokens = self.min_tokens + frac * (self.max_tokens - self.min_tokens)
+        tokens = int(tokens // self.step_size * self.step_size)
+        self.current_tokens = max(self.min_tokens, min(self.max_tokens, tokens))
+        return self.current_tokens
+
+    def state_dict(self):
+        return {"current_tokens": self.current_tokens}
+
+    def load_state_dict(self, sd):
+        self.current_tokens = sd.get("current_tokens", self.min_tokens)
+
+
+def sample_token_indices(rng, seq_len: int, keep: int) -> jnp.ndarray:
+    """Sorted random subset of token positions (token_sort.cu analog)."""
+    keep = min(keep, seq_len)
+    perm = jax.random.permutation(rng, seq_len)
+    return jnp.sort(perm[:keep])
+
+
+def gather_tokens(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """x [B, S, D] -> kept tokens [B, K, D] (gather_scatter.cu analog)."""
+    return jnp.take(x, idx, axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, kept: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Write processed kept tokens back into the full sequence."""
+    return full.at[:, idx].set(kept)
+
+
+def random_ltd_layer(layer_fn, x: jnp.ndarray, rng, keep: int) -> jnp.ndarray:
+    """Apply ``layer_fn`` to a random token subset; dropped tokens skip the
+    layer (residual identity), mirroring basic_layer.py forward."""
+    idx = sample_token_indices(rng, x.shape[1], keep)
+    kept = gather_tokens(x, idx)
+    processed = layer_fn(kept)
+    return scatter_tokens(x, processed, idx)

@@ -161,12 +161,13 @@ class Engine:
         if opt_type not in ("adam", "adamw"):
             raise ValueError(f"optimizer offload supports adam/adamw, got '{opt_type}'")
         opt_params = dict(opt_cfg.params) if opt_cfg else {}
+        from .checkpointing import _leaf_key
         flat, self._offload_treedef = jax.tree_util.tree_flatten_with_path(params)
         self._offload_keys = []
         self._offload_shapes = []
         flat_dict = {}
         for path, leaf in flat:
-            key = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            key = _leaf_key(path)
             self._offload_keys.append(key)
             self._offload_shapes.append(np.shape(leaf))
             flat_dict[key] = np.asarray(leaf, np.float32).ravel()
@@ -177,6 +178,7 @@ class Engine:
             lr=self.base_lr, betas=betas,
             eps=float(opt_params.get("eps", 1e-8)),
             weight_decay=float(opt_params.get("weight_decay", 0.0)))
+        self._offload_push_fn = None  # built lazily, cached (jit identity + shardings)
         self._push_compute_params()
         self._offload_grad_fn = None
         self._host_rng = jax.random.PRNGKey(self.config.seed)
@@ -185,8 +187,10 @@ class Engine:
         leaves = [jnp.asarray(self._offload_state.params[k].reshape(shape), self.compute_dtype)
                   for k, shape in zip(self._offload_keys, self._offload_shapes)]
         tree = jax.tree_util.tree_unflatten(self._offload_treedef, leaves)
-        shardings = self.plan.param_shardings(tree)
-        self._compute_params = jax.jit(lambda p: p, out_shardings=shardings)(tree)
+        if self._offload_push_fn is None:
+            shardings = self.plan.param_shardings(tree)
+            self._offload_push_fn = jax.jit(lambda p: p, out_shardings=shardings)
+        self._compute_params = self._offload_push_fn(tree)
 
     def _offload_train_batch(self, batch):
         gas = self.gradient_accumulation_steps
@@ -460,7 +464,7 @@ class Engine:
         m = unflatten([sd["m"][k] for k in self._offload_keys])
         v = unflatten([sd["v"][k] for k in self._offload_keys])
         return {"step": np.int32(sd["step"]), "params": params,
-                "opt_state": {"exp_avg": m, "exp_avg_sq": v}}
+                "opt_state": {"step": np.int32(sd["step"]), "exp_avg": m, "exp_avg_sq": v}}
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
         if self.offload_device is not None:

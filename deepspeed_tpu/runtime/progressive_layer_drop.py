@@ -1,0 +1,60 @@
+"""Progressive layer drop (PLD).
+
+Analog of the reference ProgressiveLayerDrop (runtime/progressive_layer_drop.py:10):
+theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar gives the GLOBAL keep
+probability; layer i of L keeps with prob 1 - (i / L) * (1 - theta) (deeper
+layers drop more).  ``pld_scan_layer`` wraps a scan layer body with the
+stochastic skip (the module-hook equivalent for functional models).
+"""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x, gamma, p):
+            return (1.0 - p) * math.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+
+def layer_keep_prob(theta: float, layer_idx, num_layers: int):
+    """Per-layer keep probability: deeper layers drop more (PLD paper schedule)."""
+    frac = (layer_idx + 1) / num_layers
+    return 1.0 - frac * (1.0 - theta)
+
+
+def pld_scan_layer(layer_fn: Callable, num_layers: int):
+    """Wrap a scan body f(x, (idx, rng, theta, params)) with stochastic skip.
+
+    Usage inside a model: carry (x); xs include layer index + per-layer rng;
+    theta traced so the schedule updates without recompiling.
+    """
+
+    def wrapped(x, inp):
+        idx, rng, theta, layer_params = inp
+        keep_p = layer_keep_prob(theta, idx, num_layers)
+        keep = jax.random.bernoulli(rng, keep_p)
+        y, aux = layer_fn(x, layer_params)
+        # identity-skip with inverse-prob rescaling of the residual delta
+        out = jnp.where(keep, x + (y - x) / jnp.maximum(keep_p, 1e-3), x)
+        return out.astype(x.dtype), aux
+
+    return wrapped

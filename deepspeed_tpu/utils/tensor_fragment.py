@@ -1,0 +1,98 @@
+"""Tensor fragment API — stable access to (possibly sharded) optimizer state.
+
+Analog of the reference tensor-fragment helpers
+(deepspeed/utils/tensor_fragment.py: safe_get_full_fp32_param:101,
+safe_set_full_fp32_param:117, safe_get_full_grad:168, local variants :189-204):
+the reference walks ZeRO partitions and flat buffers; here state lives as a
+sharded pytree, so "full" access is a gather via replicated out-sharding and
+"set" is a device_put back with the leaf's own sharding.  Paths use the
+dotted checkpoint key convention (e.g. "layers.attn.wq").
+"""
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def _resolve(tree, dotted: str):
+    node = tree
+    for part in dotted.split("."):
+        if isinstance(node, tuple) and hasattr(node, "_fields") and part in node._fields:
+            node = getattr(node, part)  # NamedTuple states (optimizer moments)
+        elif isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        elif isinstance(node, dict):
+            if part not in node:
+                raise KeyError(f"path component '{part}' not in {sorted(node)}")
+            node = node[part]
+        else:
+            node = getattr(node, part)
+    return node
+
+
+def _set(tree, dotted: str, value):
+    parts = dotted.split(".")
+    node = tree
+    for part in parts[:-1]:
+        node = node[int(part)] if isinstance(node, (list, tuple)) else node[part]
+    last = parts[-1]
+    if isinstance(node, list):
+        node[int(last)] = value
+    else:
+        node[last] = value
+
+
+def _gather_full(leaf) -> np.ndarray:
+    if isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1:
+        rep = NamedSharding(leaf.sharding.mesh, PartitionSpec())
+        leaf = jax.device_put(leaf, rep)
+    return np.asarray(leaf)
+
+
+def safe_get_full_fp32_param(engine, param_path: str) -> Optional[np.ndarray]:
+    """Gather one fp32 master parameter to host (reference :101)."""
+    if engine.offload_device is not None:
+        return _resolve(engine._offload_host_state()["params"], param_path)
+    return _gather_full(_resolve(engine.state.params, param_path))
+
+
+def safe_set_full_fp32_param(engine, param_path: str, value) -> None:
+    """Overwrite one fp32 master parameter, preserving its sharding (reference :117)."""
+    value = np.asarray(value, np.float32)
+    if engine.offload_device is not None:
+        key = param_path
+        flat = engine._offload_state.params
+        if key not in flat:
+            raise KeyError(f"{key} not in offloaded params: {sorted(flat)[:8]}...")
+        flat[key][...] = value.ravel()
+        engine._push_compute_params()
+        return
+    leaf = _resolve(engine.state.params, param_path)
+    if tuple(np.shape(leaf)) != value.shape:
+        raise ValueError(f"shape mismatch for {param_path}: {value.shape} vs {np.shape(leaf)}")
+    new_leaf = jax.device_put(value, leaf.sharding)
+    params = jax.tree_util.tree_map(lambda x: x, engine.state.params)  # shallow copy tree
+    _set(params, param_path, new_leaf)
+    engine.state = engine.state._replace(params=params)
+
+
+def safe_get_full_optimizer_state(engine, param_path: str, state_name: str) -> Optional[np.ndarray]:
+    """Gather one optimizer moment ('exp_avg'/'exp_avg_sq') (reference :134)."""
+    if engine.offload_device is not None:
+        sd = engine._offload_state.state_dict()
+        key = {"exp_avg": "m", "exp_avg_sq": "v"}[state_name]
+        return sd[key][param_path].copy()
+    moments = _resolve(engine.state.opt_state, state_name)
+    return _gather_full(_resolve(moments, param_path))
+
+
+def safe_get_full_grad(engine, param_path: str) -> Optional[np.ndarray]:
+    """Reference :168 — gradients are transient inside the compiled step, so
+    this exposes the LAST step's gradient only when grad capture is enabled via
+    engine config (see Engine.capture_grads)."""
+    grads = getattr(engine, "_last_grads", None)
+    if grads is None:
+        return None
+    return _gather_full(_resolve(grads, param_path))

@@ -1,0 +1,145 @@
+"""Data pipeline / compression / 1-bit / PLD / eigenvalue tests
+(reference tests/unit/runtime/test_data.py, compression/, onebit/, test_pld.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.compression import (fake_quantize, init_compression, row_prune_mask,
+                                       sparse_prune_mask)
+from deepspeed_tpu.runtime.comm import onebit_allreduce
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler, DeepSpeedDataSampler,
+                                                 RandomLTDScheduler, random_ltd_layer)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop, layer_keep_prob
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+
+# ----------------------------------------------------------------- curriculum
+def test_curriculum_fixed_linear():
+    s = CurriculumScheduler({"schedule_type": "fixed_linear", "min_difficulty": 8,
+                             "max_difficulty": 64, "schedule_config":
+                             {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8
+    assert s.get_difficulty(1000) == 64
+
+
+def test_curriculum_fixed_discrete():
+    s = CurriculumScheduler({"schedule_type": "fixed_discrete",
+                             "schedule_config": {"difficulty": [8, 16, 32], "max_step": [10, 20, 30]}})
+    assert s.get_difficulty(5) == 8 and s.get_difficulty(15) == 16 and s.get_difficulty(99) == 32
+
+
+def test_data_sampler_resume_and_partition():
+    mk = lambda: DeepSpeedDataSampler(total_samples=64, micro_batch_size=2,
+                                      data_parallel_rank=0, data_parallel_size=2,
+                                      gradient_accumulation_steps=2, seed=3)
+    s1 = mk()
+    it1 = iter(s1)
+    first = [next(it1) for _ in range(3)]
+    sd = s1.state_dict()
+    # all ranks' batches are disjoint within a step
+    s_r1 = DeepSpeedDataSampler(total_samples=64, micro_batch_size=2, data_parallel_rank=1,
+                                data_parallel_size=2, gradient_accumulation_steps=2, seed=3)
+    other = next(iter(s_r1))
+    assert not (set(first[0]) & set(other))
+    # resume reproduces the stream
+    s2 = mk()
+    s2.load_state_dict(sd)
+    assert next(iter(s2)) == next(it1)
+
+
+def test_random_ltd():
+    sched = RandomLTDScheduler({"min_value": 16, "max_value": 64,
+                                "schedule_config": {"seq_per_step": 16, "require_steps": 10}})
+    assert sched.update_seq(0) == 16 and sched.update_seq(10) == 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 8))
+    marker = lambda t: t + 1.0
+    out = random_ltd_layer(marker, x, jax.random.PRNGKey(1), keep=8)
+    changed = np.sum(np.any(np.asarray(out != x), axis=(0, 2)))
+    assert changed == 8  # exactly `keep` token positions processed
+
+
+# ---------------------------------------------------------------- compression
+def test_fake_quantize_bounds():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+    q8 = fake_quantize(w, bits=8)
+    assert float(jnp.abs(q8 - w).max()) < float(jnp.abs(w).max()) / 100
+    q2 = fake_quantize(w, bits=2)
+    assert len(np.unique(np.asarray(q2))) <= 4
+
+
+def test_prune_masks():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(32, 16)).astype(np.float32))
+    m = sparse_prune_mask(w, 0.25)
+    assert abs(float(m.mean()) - 0.25) < 0.05
+    r = row_prune_mask(w, 0.5)
+    kept_rows = np.unique(np.asarray(r).sum(axis=0))
+    assert set(kept_rows.tolist()) <= {0.0, 32.0}
+
+
+def test_init_compression_targets_modules():
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=32, nlayers=2)
+    cfg = {"weight_quantization": {"different_groups": {
+        "g": {"params": {"target_bits": 4}, "modules": ["layer_0"]}}}}
+    out = init_compression(params, cfg)
+    assert not np.allclose(np.asarray(out["layer_0"]["w"]), np.asarray(params["layer_0"]["w"]))
+    np.testing.assert_array_equal(np.asarray(out["layer_1"]["w"]), np.asarray(params["layer_1"]["w"]))
+
+
+# ----------------------------------------------------------------- 1-bit comm
+def test_onebit_allreduce_error_feedback_converges():
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    n = 1024
+    gs = jax.random.normal(jax.random.PRNGKey(0), (8, n))
+    ref = np.asarray(gs).mean(axis=0)
+
+    def body(g, e):
+        est, new_e = onebit_allreduce(g[0], e[0], "dp")
+        return est, new_e[None, :]
+
+    f = shard_map(body, mesh=mesh, in_specs=(P("dp", None), P("dp", None)),
+                  out_specs=(P(None), P("dp", None)), check_vma=False)
+    est, err = f(gs, jnp.zeros((8, n)))
+    # single step: correlated with true mean
+    assert np.corrcoef(np.asarray(est), ref)[0, 1] > 0.5
+    # repeated reduction of the SAME gradient with error feedback -> converges
+    accum = np.zeros(n)
+    e = jnp.zeros((8, n))
+    for i in range(12):
+        est, e = f(gs, e)
+        accum += np.asarray(est)
+    # time-averaged estimate approaches the true mean (error feedback property)
+    assert np.corrcoef(accum / 12, ref)[0, 1] > 0.97
+
+
+# ------------------------------------------------------------------ PLD + eig
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    t0 = pld.update_state(0)
+    t_mid = pld.update_state(100)
+    t_end = pld.update_state(100000)
+    assert t0 == 1.0 and t0 > t_mid > t_end
+    assert abs(t_end - 0.5) < 1e-3
+    assert layer_keep_prob(0.5, 9, 10) == pytest.approx(0.5)
+    assert layer_keep_prob(0.5, 0, 10) == pytest.approx(0.95)
+
+
+def test_eigenvalue_power_iteration_quadratic():
+    # loss = 0.5 x^T A x has Hessian A; dominant eigenvalue known
+    a = np.diag([5.0, 2.0, 1.0]).astype(np.float32)
+
+    def loss_fn(p, batch, rng):
+        x = p["x"]
+        return 0.5 * x @ jnp.asarray(a) @ x
+
+    eig = Eigenvalue(max_iter=50, tol=1e-4)
+    out = eig.compute_eigenvalue(loss_fn, {"x": jnp.asarray([1.0, 1.0, 1.0])}, None)
+    assert abs(out["eigenvalue"] - 5.0) < 0.05

@@ -1,0 +1,48 @@
+"""Elasticity solver tests (reference tests/unit/elasticity/test_elastic.py)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, get_best_candidates, get_valid_gpus)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+    }
+}
+
+
+def test_valid_gpus_basic():
+    # batch 24, micro [2, 3]: worlds dividing max_world 12 (mb=2) or 8 (mb=3)
+    v = get_valid_gpus(24, [2, 3], 1, 100)
+    assert v == [1, 2, 3, 4, 6, 8, 12]
+
+
+def test_best_candidates_reference_case():
+    """Reference test: the 10k/[8,12,16,17] case finds a highly-divisible batch."""
+    batch, valid, _ = get_best_candidates(10000, [8, 12, 16, 17], 32, 1500)
+    assert batch is not None and batch <= 10000
+    assert len(valid) > 20
+    for w in valid:
+        assert any(batch % mb == 0 and (batch // mb) % w == 0 for mb in [8, 12, 16, 17])
+
+
+def test_compute_elastic_config():
+    batch, valid = compute_elastic_config(BASE)
+    assert batch and valid
+    w = valid[len(valid) // 2]
+    b2, v2, micro = compute_elastic_config(BASE, world_size=w, return_microbatch=True)
+    assert b2 == batch and micro is not None and (batch // w) % micro == 0
+
+
+def test_incompatible_world_size_raises():
+    with pytest.raises(ValueError, match="not in the elastic-compatible"):
+        compute_elastic_config(BASE, world_size=31)  # below min_gpus
+
+
+def test_disabled_raises():
+    with pytest.raises(ValueError):
+        compute_elastic_config({"elasticity": {"enabled": False}})

@@ -1,0 +1,64 @@
+"""Launcher CLI tests (reference tests/unit/launcher/test_run.py,
+test_multinode_runner.py patterns: hostfile parsing, include/exclude filters,
+runner command construction)."""
+
+import pytest
+
+from deepspeed_tpu.launcher import (PDSHRunner, SSHRunner, decode_world_info, encode_world_info,
+                                    fetch_hostfile, parse_inclusion_exclusion)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("# comment\nworker-0 slots=4\nworker-1 slots=4\nworker-2 slots=8\n")
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    r = fetch_hostfile(hostfile)
+    assert r == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+
+
+def test_fetch_hostfile_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fetch_hostfile(str(tmp_path / "nope"))
+    bad = tmp_path / "dup"
+    bad.write_text("h1 slots=2\nh1 slots=4\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(str(bad))
+
+
+def test_include_exclude(hostfile):
+    r = fetch_hostfile(hostfile)
+    assert parse_inclusion_exclusion(r, include="worker-0@worker-2") == {"worker-0": 4, "worker-2": 8}
+    assert parse_inclusion_exclusion(r, include="worker-2:0,1,2,3") == {"worker-2": 4}
+    assert parse_inclusion_exclusion(r, exclude="worker-1") == {"worker-0": 4, "worker-2": 8}
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(r, include="x", exclude="y")
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(r, include="missing-host")
+
+
+def test_world_info_roundtrip():
+    w = {"a": 4, "b": 8}
+    assert decode_world_info(encode_world_info(w)) == w
+
+
+class _Args:
+    user_script = "train.py"
+    user_args = ["--foo", "1"]
+
+
+def test_pdsh_cmd_construction():
+    r = PDSHRunner(_Args(), {"h1": 4, "h2": 4})
+    cmd = r.get_cmd({"COORDINATOR_ADDRESS": "h1:29500"}, {"h1": 4, "h2": 4})
+    assert cmd[0] == "pdsh" and "h1,h2" in cmd
+    assert "deepspeed_tpu.launcher.launch" in cmd[-1] and "train.py" in cmd[-1]
+
+
+def test_ssh_cmds_have_ranks():
+    r = SSHRunner(_Args(), {"h1": 4, "h2": 4})
+    cmds = r.get_cmds({"NUM_PROCESSES": "2"}, {"h1": 4, "h2": 4})
+    assert len(cmds) == 2
+    assert "PROCESS_ID=0" in cmds[0][-1] and "PROCESS_ID=1" in cmds[1][-1]
