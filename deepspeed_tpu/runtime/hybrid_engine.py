@@ -1,0 +1,70 @@
+"""Hybrid engine — RLHF train ↔ generate flips.
+
+Analog of DeepSpeedHybridEngine (runtime/hybrid_engine.py:32): the reference
+flips a ZeRO-3 training model into inference-kernel mode for rollout
+generation (generate:174, _zero3_forward:363).  Here the flip is a dtype cast
++ resharding of the CURRENT master params into the v1 inference engine's
+jitted prefill/decode programs — compiled once, re-fed fresh weights each
+rollout (weight swap is a device-side cast, no recompilation).
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.engine import InferenceEngine
+from ..utils.logging import log_dist
+from .engine import Engine
+
+
+class DeepSpeedHybridEngine(Engine):
+    """Training engine + in-loop generation over the same weights.
+
+    Extra ctor args: ``model_module`` (models.llama-style: forward_with_cache,
+    init_cache) and ``model_config``; ``loss_fn`` still drives training.
+    """
+
+    def __init__(self, *args, model_module=None, model_config=None,
+                 inference_config: Optional[Dict] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        if model_module is None:
+            raise ValueError("DeepSpeedHybridEngine needs model_module (and model_config)")
+        self.model_module = model_module
+        self.model_config = model_config
+        self._inf_cfg = dict(inference_config or {})
+        self._inf_cfg.setdefault("dtype", "bfloat16" if self.compute_dtype == jnp.bfloat16 else "float32")
+        self._inf_engine: Optional[InferenceEngine] = None
+        self._params_version = -1
+        log_dist("HybridEngine: training + rollout generation enabled", ranks=[0])
+
+    # ------------------------------------------------------------- the flip
+    def _current_params16(self):
+        if self.offload_device is not None:
+            return self._compute_params
+        cast = jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype), self.state.params)
+        return cast
+
+    def _refresh_inference(self):
+        if self._inf_engine is None:
+            self._inf_engine = InferenceEngine(self.model_module, self.model_config,
+                                               self._current_params16(),
+                                               config=self._inf_cfg,
+                                               topology=self.topology)
+        elif self._params_version != self.global_steps:
+            # weight swap only: keep the compiled prefill/decode programs
+            self._inf_engine.params = self._inf_engine._shard_params(self._current_params16())
+        self._params_version = self.global_steps
+
+    # ------------------------------------------------------------ public API
+    def generate(self, input_ids, **kwargs) -> np.ndarray:
+        """Rollout generation from the CURRENT training weights
+        (reference generate:174)."""
+        self._refresh_inference()
+        return self._inf_engine.generate(input_ids, **kwargs)
+
+    def eval_forward(self, input_ids):
+        """Logits from current weights (scoring rollouts / reward model)."""
+        self._refresh_inference()
+        return self._inf_engine.forward(input_ids)

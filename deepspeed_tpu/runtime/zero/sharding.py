@@ -161,6 +161,16 @@ class ShardingPlan:
 
 def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRuleFn] = None) -> ShardingPlan:
     axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if topo.axis_size(a) > 1) or (DATA_AXIS, )
+    mics = int(getattr(zero_config, "mics_shard_size", -1) or -1)
+    if mics > 0 and zero_config.stage >= 3:
+        # MiCS (reference runtime/zero/mics.py:48): ZeRO-3 scoped to a shard
+        # group — params partitioned over the 'fsdp' axis only (the replica
+        # scale-out rides 'data'; grads still reduce over both).  The mesh's
+        # fsdp axis IS the shard group; its size must match mics_shard_size.
+        if topo.axis_size(FSDP_AXIS) != mics:
+            raise ValueError(f"mics_shard_size={mics} requires mesh axis fsdp={mics} "
+                             f"(got fsdp={topo.axis_size(FSDP_AXIS)}); replicas ride 'data'")
+        axes = (FSDP_AXIS, )
     threshold = zero_config.param_persistence_threshold if zero_config.stage >= 3 else 0
     return ShardingPlan(topo=topo,
                         stage=zero_config.stage,

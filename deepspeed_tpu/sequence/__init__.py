@@ -1,1 +1,2 @@
 from .layer import DistributedAttention, single_all_to_all, ulysses_attention
+from .ring import ring_attention
