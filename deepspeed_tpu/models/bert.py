@@ -11,7 +11,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from .transformer import cross_entropy_loss, gelu_mlp, init_linear, layer_norm, sdpa
+from .transformer import cross_entropy_loss, default_attention, gelu_mlp, init_linear, layer_norm, sdpa
 
 
 @dataclasses.dataclass(frozen=True)
@@ -75,7 +75,7 @@ def forward(config: BertConfig, params, input_ids, token_type_ids=None, attentio
         x = x + params["type_emb"][token_type_ids]
     x = layer_norm(x, params["emb_ln_w"], params["emb_ln_b"], config.ln_eps)
     H = config.num_heads
-    attn_fn = attention_fn or sdpa
+    attn_fn = attention_fn or default_attention()
     mask = None
     if attention_mask is not None:
         mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,S] broadcast over heads/query

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import cross_entropy_loss, gelu_mlp, init_linear, layer_norm, sdpa
+from .transformer import cross_entropy_loss, default_attention, gelu_mlp, init_linear, layer_norm, sdpa
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,7 +69,7 @@ def forward(config: GPT2Config, params, input_ids, attention_fn=None):
     b, s = input_ids.shape
     x = params["wte"][input_ids] + params["wpe"][:s][None]
     H = config.num_heads
-    attn_fn = attention_fn or sdpa
+    attn_fn = attention_fn or default_attention()
 
     def layer(x, lp):
         h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], config.ln_eps)

@@ -32,7 +32,10 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = True
-    remat_policy: Optional[str] = "nothing_saveable"
+    # dots_with_no_batch_dims_saveable keeps per-layer matmul outputs (cheap to
+    # store, expensive to recompute) and recomputes the rest — measured ~1.5x
+    # faster than nothing_saveable at 438M/seq2048 on v5e (53% vs 35% MFU)
+    remat_policy: Optional[str] = "dots_with_no_batch_dims_saveable"
 
     @staticmethod
     def llama2_7b():

@@ -90,6 +90,18 @@ def sdpa(q, k, v, causal=True, mask=None, softmax_scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def default_attention():
+    """Resolve the attention impl for this backend: the Pallas flash kernel on
+    TPU (ops/attention/flash.py — the reference's fused-attention analog,
+    csrc/transformer/ds_attention.cu), plain XLA sdpa elsewhere.  Callers that
+    pass an explicit ``attention_fn`` (Ulysses, blocksparse, tests) override it."""
+    from ..ops import _pallas
+    if _pallas.use_pallas():
+        from ..ops.attention.flash import flash_attention
+        return flash_attention
+    return sdpa
+
+
 def attention_block(params, x, *, n_heads, n_kv_heads, cos, sin, causal=True,
                     attention_fn=None, positions=None, kv_cache=None):
     """Multi-head attention with rotary + GQA.
@@ -115,14 +127,14 @@ def attention_block(params, x, *, n_heads, n_kv_heads, cos, sin, causal=True,
         # mask out cache positions beyond cache_len + s
         kpos = jnp.arange(k_cache.shape[1])[None, None, None, :]
         valid = kpos < (cache_len + s)
-        attn_fn = attention_fn or sdpa
+        attn_fn = attention_fn or default_attention()
         qpos = (jnp.arange(s) + cache_len)
         # causal over absolute positions
         causal_mask = kpos[:, :, :, :] <= qpos[None, None, :, None]
         out = attn_fn(q, k_full, v_full, causal=False, mask=jnp.logical_and(valid, causal_mask))
         new_cache = (k_cache, v_cache, cache_len + s)
     else:
-        attn_fn = attention_fn or sdpa
+        attn_fn = attention_fn or default_attention()
         out = attn_fn(q, k, v, causal=causal)
     out = out.reshape(b, s, n_heads * head_dim)
     out = out @ params["wo"].astype(x.dtype)

@@ -318,7 +318,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, causal: bool = True, mask=None,
                     softmax_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """Drop-in for models.transformer.sdpa: q/k/v [B, S, H, D], GQA allowed.
 
     Dense ``mask`` forces the XLA fallback (the blocked kernel handles only the

@@ -257,6 +257,11 @@ class Engine:
             log_dist(f"hpZ secondary partition follows the fsdp mesh axis "
                      f"(size {topo.axis_size('fsdp')}), not zero_hpz_partition_size="
                      f"{zero_cfg.zero_hpz_partition_size}", ranks=[0])
+        # Pallas fused optimizer step: single-device only (pallas_call under
+        # GSPMD would replicate sharded leaves); multi-device runs the identical
+        # delta-form math, which XLA shards per the plan.
+        fused_step = optimizer.step_fn if (optimizer.step_fn is not None
+                                           and self.topology.mesh.devices.size == 1) else None
         compute_shardings = None
         if self.zero_stage < 3:
             # Replicated over dp (keeping any tensor-parallel dims sharded): the
@@ -306,15 +311,21 @@ class Engine:
             overflow = jnp.logical_or(has_overflow(grads), jnp.logical_not(jnp.isfinite(norm))) if fp16 \
                 else jnp.zeros((), jnp.bool_)
 
-            updates, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
-            new_params = jax.tree_util.tree_map(lambda p, u: p + u, state.params, updates)
+            if fused_step is not None:
+                new_params, new_opt = fused_step(grads, state.opt_state, state.params, lr)
+            else:
+                updates, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
+                new_params = jax.tree_util.tree_map(lambda p, u: p + u, state.params, updates)
 
-            # fp16 overflow: skip the update (reference step:1786 overflow path)
-            def pick(new, old):
-                return jax.tree_util.tree_map(lambda a, b: jnp.where(overflow, b, a), new, old)
+            # fp16 overflow: skip the update (reference step:1786 overflow path).
+            # bf16/fp32 never overflows-skips — eliding the select keeps the old
+            # params dead so the fused step's buffer aliasing holds.
+            if fp16:
+                def pick(new, old):
+                    return jax.tree_util.tree_map(lambda a, b: jnp.where(overflow, b, a), new, old)
 
-            new_params = pick(new_params, state.params)
-            new_opt = pick(new_opt, state.opt_state)
+                new_params = pick(new_params, state.params)
+                new_opt = pick(new_opt, state.opt_state)
             new_ls = update_loss_scale(state.loss_scale, overflow, fp16_cfg) if fp16 else None
 
             new_state = TrainState(step=state.step + jnp.where(overflow, 0, 1),

@@ -26,6 +26,11 @@ class Optimizer:
     init: Callable
     update: Callable  # (grads, state, params, lr) -> (updates, new_state)
     name: str = "optimizer"
+    # Optional fused whole-step: (grads, state, params, lr) -> (new_params, new_state).
+    # When set, the engine applies it directly (no delta round-trip) so the Pallas
+    # flat-buffer kernel (ops/adam/fused_adam.py) does ONE aliased HBM pass —
+    # the multi-tensor-apply analog (csrc/adam/multi_tensor_adam.cu).
+    step_fn: Optional[Callable] = None
 
 
 def _tree_zeros_like(params, dtype=None):
@@ -75,6 +80,40 @@ def adam(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adam_w_mode=True, bias_
         return updates, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
 
     return Optimizer(init=init, update=update, name="adamw" if adam_w_mode else "adam")
+
+
+def fused_adam(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adam_w_mode=True,
+               bias_correction=True) -> Optimizer:
+    """FusedAdam backed by the Pallas flat-buffer kernel (ops/adam/fused_adam.py,
+    the csrc/adam/multi_tensor_adam.cu analog).  ``step_fn`` ravels each leaf and
+    updates p/m/v in one aliased VMEM sweep; the generic delta-form ``update``
+    stays available (identical math via the plain-jnp path) for callers that
+    need deltas (offload, tests)."""
+    from ..ops.adam.fused_adam import fused_adamw_flat
+    base = adam(betas=betas, eps=eps, weight_decay=weight_decay,
+                adam_w_mode=adam_w_mode, bias_correction=bias_correction)
+    b1, b2 = betas
+
+    def step_fn(grads, state, params, lr):
+        step = state.step + 1
+
+        def leaf(g, m, v, p):
+            p2, m2, v2 = fused_adamw_flat(p.ravel(), m.ravel(), v.ravel(), g.ravel(),
+                                          lr=lr, beta1=b1, beta2=b2, eps=eps,
+                                          weight_decay=weight_decay, step=step)
+            return p2.reshape(p.shape), m2.reshape(m.shape), v2.reshape(v.shape)
+
+        flat = jax.tree_util.tree_map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
+        istup = lambda t: isinstance(t, tuple)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=istup)
+        m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=istup)
+        v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=istup)
+        return new_params, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
+
+    # the kernel hard-codes decoupled decay + bias correction; other modes run
+    # through the generic path only
+    return Optimizer(init=base.init, update=base.update, name="fused_adam",
+                     step_fn=step_fn if (adam_w_mode and bias_correction) else None)
 
 
 class SGDState(NamedTuple):
@@ -209,7 +248,7 @@ def _register(names, builder):
 
 _register(["adam"], lambda lr=None, **kw: adam(adam_w_mode=False, **_strip(kw)))
 _register(["adamw"], lambda lr=None, **kw: adam(adam_w_mode=True, **_strip(kw)))
-_register(["fusedadam", "fused_adam"], lambda lr=None, **kw: adam(**_strip(kw)))
+_register(["fusedadam", "fused_adam"], lambda lr=None, **kw: fused_adam(**_strip(kw)))
 _register(["sgd"], lambda lr=None, **kw: sgd(**_strip(kw)))
 _register(["lion", "fusedlion"], lambda lr=None, **kw: lion(**_strip(kw)))
 _register(["adagrad"], lambda lr=None, **kw: adagrad(**_strip(kw)))

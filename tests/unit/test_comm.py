@@ -127,3 +127,18 @@ def test_comms_logger_records(mesh8):
         assert "all_reduce" in summary
     finally:
         cl.enabled = False
+
+
+def test_collective_bandwidth_microbench(mesh8):
+    """ds_bench analog: the sweep runs real collectives over the 8-dev mesh and
+    reports sane numbers (BASELINE.json tracks allgather bucket bandwidth)."""
+    from deepspeed_tpu.comm.benchmark import collective_bandwidth, run_sweep
+    r = collective_bandwidth("all_gather", elems=8 * 1024, axis="data",
+                             topology=mesh8, iters=2)
+    assert r["world"] == 8
+    assert r["algbw_gbps"] > 0
+    assert abs(r["busbw_gbps"] - r["algbw_gbps"] * 7 / 8) < 1e-9
+    results = run_sweep(ops=("all_reduce", "reduce_scatter"), elems=8 * 1024,
+                        topology=mesh8, iters=1)
+    assert [r["op"] for r in results] == ["all_reduce", "reduce_scatter"]
+    assert all(x["time_ms"] > 0 for x in results)
