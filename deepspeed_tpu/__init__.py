@@ -11,6 +11,7 @@ from typing import Any, Callable, Optional, Tuple
 
 from . import comm
 from .comm import init_distributed
+from .runtime import zero
 from .parallel.mesh import MeshTopology
 from .runtime.config import TrainingConfig, load_config
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
@@ -26,6 +27,7 @@ def initialize(args=None,
                dist_init_required: Optional[bool] = None,
                collate_fn=None,
                tp_rules=None,
+               param_init_fn: Optional[Callable] = None,
                **kwargs):
     """Build a training engine (reference deepspeed.initialize, __init__.py:64).
 
@@ -59,7 +61,8 @@ def initialize(args=None,
 
     if tp_rules is None and model is not None:
         tp_rules = getattr(model, "tp_rules", None)
-    engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules)
+    engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules,
+                    param_init_fn=param_init_fn)
 
     dataloader = None
     if training_data is not None:

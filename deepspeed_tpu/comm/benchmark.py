@@ -9,7 +9,6 @@ allgather/reduce-scatter, 2(n-1)/n for allreduce) so numbers are comparable to
 NCCL-tests / the reference's CommsLogger accounting (utils/comms_logging.py:67).
 """
 
-import functools
 import time
 from typing import Dict, Optional
 
@@ -119,7 +118,7 @@ def main(argv=None):
     parser.add_argument("--iters", type=int, default=10)
     parser.add_argument("--ops", nargs="*", default=["all_gather", "all_reduce", "reduce_scatter", "all_to_all"])
     args = parser.parse_args(argv)
-    from ..parallel.mesh import MeshTopology, get_topology, set_topology
+    from ..parallel.mesh import set_topology
     try:
         topo = get_topology()
     except Exception:

@@ -258,6 +258,72 @@ def from_hf_state_dict(config: LlamaConfig, state_dict, dtype=jnp.float32):
     return params
 
 
+def abstract_params(config: LlamaConfig, dtype=jnp.float32):
+    """Meta-device skeleton (zero bytes): the OnDevice/zero.Init abstract half
+    (ref utils/init_on_device.py:12)."""
+    return jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0), dtype=dtype))
+
+
+def hf_streaming_loader(config: LlamaConfig, get_tensor: Callable[[str], Any]):
+    """Build a ``get_leaf`` for zero.Init.materialize_from_loader that streams a
+    HuggingFace Llama checkpoint **one layer-tensor at a time** — the analog of
+    shard-by-shard checkpoint loading into ZeRO-3 (module_inject/load_checkpoint.py).
+
+    ``get_tensor(hf_name) -> array-like`` (e.g. a safetensors lazy handle or a
+    torch state_dict lookup).  Stacked per-layer leaves are returned as slice
+    callbacks, so a device owning layers [a:b) of wq only ever pulls those
+    layers' tensors; peak host memory is O(one layer tensor), not O(leaf).
+    """
+
+    def t(name):
+        w = get_tensor(name)
+        w = w.float().numpy() if hasattr(w, "float") else np.asarray(w, dtype=np.float32)
+        return w
+
+    fmt = {
+        "layers.attn.wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+        "layers.attn.wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+        "layers.attn.wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+        "layers.attn.wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+        "layers.mlp.w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+        "layers.mlp.w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+        "layers.mlp.w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+        "layers.attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+        "layers.mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
+    }
+
+    def get_leaf(path, leaf):
+        if path == "embed":
+            return t("model.embed_tokens.weight")
+        if path == "final_norm":
+            return t("model.norm.weight")
+        if path == "lm_head":
+            name = "lm_head.weight" if _has(get_tensor, "lm_head.weight") else "model.embed_tokens.weight"
+            return t(name).T
+        name_fmt, transpose = fmt[path]
+
+        def slice_cb(idx):
+            layers = range(*idx[0].indices(config.num_layers))
+            parts = []
+            for i in layers:
+                w = t(name_fmt.format(i))
+                if transpose:
+                    w = w.T
+                parts.append(w[idx[1:]] if len(idx) > 1 else w)
+            return np.stack(parts)
+
+        return slice_cb
+
+    return get_leaf
+
+
+def _has(get_tensor, name) -> bool:
+    try:
+        return get_tensor(name) is not None
+    except Exception:
+        return False
+
+
 def config_from_hf(hf_config) -> LlamaConfig:
     """Build a LlamaConfig from a transformers LlamaConfig/MistralConfig."""
     return LlamaConfig(

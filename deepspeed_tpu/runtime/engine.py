@@ -66,7 +66,8 @@ class Engine:
                  config: TrainingConfig,
                  topology: Optional[MeshTopology] = None,
                  dp_world_size: Optional[int] = None,
-                 tp_rules=None):
+                 tp_rules=None,
+                 param_init_fn: Optional[Callable] = None):
         self.config = config
         self.loss_fn = loss_fn
         self.topology = topology or MeshTopology.build(config.mesh)
@@ -103,12 +104,25 @@ class Engine:
 
         off = config.zero_optimization.offload_optimizer
         self.offload_device = off.device if (off is not None and off.device != "none") else None
+        abstract = any(isinstance(p, jax.ShapeDtypeStruct) for p in jax.tree_util.tree_leaves(params))
+        if abstract and param_init_fn is None:
+            raise ValueError("model_parameters is abstract (ShapeDtypeStruct leaves); "
+                             "pass param_init_fn so the engine can materialize shards "
+                             "(zero.Init semantics, ref partition_parameters.py:786)")
         if self.offload_device is not None:
+            if abstract:
+                # offload wants the master on HOST anyway — materialize on the
+                # CPU backend so the full fp32 tree never touches HBM
+                cpu = jax.local_devices(backend="cpu")[0]
+                with jax.default_device(cpu):
+                    params = param_init_fn()
             self._init_offload(params, off)
             self.state = None
+        elif abstract:
+            self.state = self._init_state_sharded(param_init_fn)
         else:
             self.state = self._init_state(params)
-        n_params = sum(int(np.prod(np.shape(p))) for p in jax.tree_util.tree_leaves(params))
+        n_params = sum(int(np.prod(getattr(p, "shape", ()) or ())) for p in jax.tree_util.tree_leaves(params))
         log_dist(
             f"Engine: zero_stage={self.zero_stage} dp_world={self.dp_world_size} "
             f"batch={self.train_batch_size} (micro={self.micro_batch_size} x gas="
@@ -135,6 +149,27 @@ class Engine:
         shardings = self._state_shardings(shapes)
         init_fn = jax.jit(make_state, out_shardings=shardings)
         return init_fn(params)
+
+    def _init_state_sharded(self, param_init_fn: Callable) -> TrainState:
+        """zero.Init path (ref partition_parameters.py:786): params are built
+        INSIDE the jitted state constructor with sharded out_shardings, so every
+        leaf is computed/stored already partitioned — no host or single-device
+        full copy of a 7B model ever exists."""
+
+        def make_state():
+            p = param_init_fn()
+            master = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
+            opt_state = self.optimizer.init(master)
+            ls = init_loss_scale(self.config.fp16) if self.fp16_enabled else None
+            return TrainState(step=jnp.zeros((), jnp.int32),
+                              params=master,
+                              opt_state=opt_state,
+                              loss_scale=ls,
+                              rng=jax.random.PRNGKey(self.config.seed))
+
+        shapes = jax.eval_shape(make_state)
+        shardings = self._state_shardings(shapes)
+        return jax.jit(make_state, out_shardings=shardings)()
 
     def _state_shardings(self, state_shapes: TrainState) -> TrainState:
         rep = NamedSharding(self.topology.mesh, PartitionSpec())

@@ -1,0 +1,14 @@
+"""ZeRO subsystem — sharding plans, quantized collectives, sharded init.
+
+The public surface mirrors ``deepspeed.zero``: ``Init`` / ``GatheredParameters``
+(reference partition_parameters.py:786,2044) re-exported here and at
+``deepspeed_tpu.zero``.
+"""
+
+from .init import GatheredParameters, Init, init, max_loader_bytes, reset_loader_stats
+from .sharding import ShardingPlan, build_sharding_plan
+
+__all__ = [
+    "GatheredParameters", "Init", "init", "max_loader_bytes", "reset_loader_stats",
+    "ShardingPlan", "build_sharding_plan",
+]
