@@ -54,6 +54,25 @@ class StepMetrics(NamedTuple):
     loss_scale: jnp.ndarray
 
 
+def _mesh_config_for(config: TrainingConfig):
+    """Honor zero_hpz_partition_size (reference zero/config.py:264) when the
+    user didn't lay out the mesh: at stage 3 with hpZ requested and mesh axes
+    left at defaults, factor the devices into data x fsdp with
+    fsdp = hpz_partition_size (the secondary/intra-slice shard group)."""
+    mesh_cfg = config.mesh
+    hpz = config.zero_optimization.zero_hpz_partition_size
+    other_axes = int(np.prod([s for a, s in mesh_cfg.axis_sizes().items()
+                              if a not in ("data", "fsdp") and s != -1]))
+    if (config.zero_optimization.stage >= 3 and hpz > 1
+            and mesh_cfg.fsdp == 1 and mesh_cfg.data == -1
+            and jax.device_count() % (hpz * other_axes) == 0):
+        from .config import MeshConfig
+        sizes = mesh_cfg.axis_sizes()
+        sizes["fsdp"] = hpz
+        mesh_cfg = MeshConfig(**sizes, axis_order=list(mesh_cfg.axis_order))
+    return mesh_cfg
+
+
 class Engine:
     """Wraps a loss function + params with distributed training mechanics.
 
@@ -70,7 +89,7 @@ class Engine:
                  param_init_fn: Optional[Callable] = None):
         self.config = config
         self.loss_fn = loss_fn
-        self.topology = topology or MeshTopology.build(config.mesh)
+        self.topology = topology or MeshTopology.build(_mesh_config_for(config))
         set_topology(self.topology)
         self.dp_world_size = dp_world_size or self.topology.get_data_parallel_world_size()
         (self.train_batch_size, self.micro_batch_size,
@@ -275,16 +294,27 @@ class Engine:
         dp_world = 1
         for a in self.plan.shard_axes:
             dp_world *= topo.axis_size(a)
-        qgz = bool(zero_cfg.zero_quantized_gradients) and 1 <= self.zero_stage <= 2 and pure_dp and dp_world > 1
+        qgz = (bool(zero_cfg.zero_quantized_gradients) and 1 <= self.zero_stage <= 2
+               and pure_dp and dp_world > 1 and not fp16)
         qwz = bool(zero_cfg.zero_quantized_weights) and 1 <= self.zero_stage <= 2 and pure_dp and dp_world > 1
+        # stage-3 ZeRO++ (hierarchical over data=slow / fsdp=fast; reference
+        # partition_parameters.py:1171-1243 + coalesced_collectives.py:31):
+        # requires both axes so the quantized hop ('data') is distinct from the
+        # GSPMD per-layer gather axis ('fsdp' — the hpZ secondary partition)
+        # fp16 is excluded: int4 quantization would launder grad inf/nan into
+        # finite values before overflow detection, defeating loss-scale skips
+        zpp3 = (self.zero_stage >= 3 and pure_dp and not fp16
+                and self.plan.shard_axes == ("data", "fsdp")
+                and topo.axis_size("data") > 1 and topo.axis_size("fsdp") > 1
+                and bool(zero_cfg.zero_quantized_gradients or zero_cfg.zero_quantized_weights))
         hpz = (zero_cfg.zero_hpz_partition_size > 1 and self.zero_stage >= 3
                and topo.axis_size("fsdp") > 1)
-        if zero_cfg.zero_quantized_gradients and not qgz:
-            log_dist("zero_quantized_gradients requested but inactive (needs stage 1-2, "
-                     "pure dp/fsdp mesh, dp world > 1)", ranks=[0])
-        if zero_cfg.zero_quantized_weights and not qwz:
-            log_dist("zero_quantized_weights requested but inactive (needs stage 1-2, "
-                     "pure dp/fsdp mesh, dp world > 1)", ranks=[0])
+        if zero_cfg.zero_quantized_gradients and not (qgz or zpp3):
+            log_dist("zero_quantized_gradients requested but inactive (needs pure dp/fsdp "
+                     "mesh with dp world > 1; stage 3 additionally needs data>1 AND fsdp>1)", ranks=[0])
+        if zero_cfg.zero_quantized_weights and not (qwz or zpp3):
+            log_dist("zero_quantized_weights requested but inactive (needs pure dp/fsdp "
+                     "mesh with dp world > 1; stage 3 additionally needs data>1 AND fsdp>1)", ranks=[0])
         if zero_cfg.zero_hpz_partition_size > 1 and not hpz:
             log_dist("zero_hpz_partition_size requested but inactive (needs stage 3 and "
                      "an fsdp mesh axis > 1)", ranks=[0])
@@ -320,17 +350,29 @@ class Engine:
         if qgz:
             from .zero.quantized import make_qgz_grad_fn
             qgz_grad_fn = make_qgz_grad_fn(loss_fn, topo.mesh, plan.shard_axes, gas)
+        zpp3_fn = None
+        if zpp3:
+            from .zero.quantized import make_zpp3_grad_fn
+            zpp3_fn = make_zpp3_grad_fn(loss_fn, topo.mesh, plan, gas,
+                                        qwz=bool(zero_cfg.zero_quantized_weights),
+                                        qgz=bool(zero_cfg.zero_quantized_gradients),
+                                        compute_dtype=compute_dtype)
 
         def train_step(state: TrainState, batch) -> Tuple[TrainState, StepMetrics]:
             rng, step_rng = jax.random.split(state.rng)
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
-            params16 = cast_for_compute(state.params)
             micro_rngs = jax.random.split(step_rng, gas)
 
-            if qgz_grad_fn is not None:
+            if zpp3_fn is not None:
+                # stage-3 ZeRO++: int8 gather + int4 hierarchical grad reduction
+                # straight from/to the fp32 master layout
+                grads, loss_sum = zpp3_fn(state.params, batch, micro_rngs, scale)
+            elif qgz_grad_fn is not None:
                 # qgZ: explicit int4-quantized dp gradient reduction (shard_map)
+                params16 = cast_for_compute(state.params)
                 grads, loss_sum = qgz_grad_fn(params16, batch, micro_rngs, scale)
             else:
+                params16 = cast_for_compute(state.params)
                 grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, micro_rngs, scale)
 
             # average over micro-batches and unscale; dp reduction happens via

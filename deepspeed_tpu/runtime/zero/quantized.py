@@ -116,13 +116,7 @@ def qwz_cast_gather(master, mesh, dp_axes: Sequence[str], compute_dtype, group_s
 
         # ask the sharding plan which dim the master leaf is actually sharded on
         # so the explicit gather matches the stored layout (no extra reshard)
-        shard_dim = None
-        if plan is not None:
-            spec = plan._spec_for_shape(x.shape, sharded=True)
-            for d, s in enumerate(spec):
-                if s is not None:
-                    shard_dim = d
-                    break
+        shard_dim = _data_dim(plan, x.shape, axes) if plan is not None else None
         if shard_dim is None:
             shard_dim = _sharded_dim(x.shape, world)
         if shard_dim is None:
@@ -143,3 +137,110 @@ def _sharded_dim(shape, world):
     if not candidates:
         return None
     return max(candidates, key=lambda t: t[1])[0]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO++ for stage 3: hierarchical over ('data' = slow/inter-slice, 'fsdp' =
+# fast/ICI).  The reference factors qgZ over local/global node groups
+# (coalesced_collectives.py:31 via groups.py:356 _get_local_all_to_all_group)
+# and gathers qwZ int8 across nodes with the hpZ secondary shard served
+# intra-node (partition_parameters.py:1171-1243).  TPU mapping: shard_map with
+# axis_names={'data'} takes MANUAL control of the slow hop (int8 param gather,
+# int4 grad reduce-scatter) while 'fsdp' stays on GSPMD auto — per-layer bf16
+# gathers inside the model's scan ride ICI, exactly the hpZ secondary layout.
+# ---------------------------------------------------------------------------
+
+
+def _data_dim(plan, shape, axes):
+    """Dim of ``shape`` the plan shards over any of the given mesh ``axes``
+    (str or tuple) — the single source of truth for 'which dim carries the
+    ZeRO shard' used by qwZ gathers and the stage-3 hierarchical paths."""
+    want = (axes, ) if isinstance(axes, str) else tuple(axes)
+    spec = plan._spec_for_shape(tuple(shape), sharded=True)
+    for d, s in enumerate(spec):
+        entries = s if isinstance(s, tuple) else (s, )
+        if s is not None and any(a in entries for a in want):
+            return d
+    return None
+
+
+def _manual_data_spec(plan, tree, data_axis):
+    """in/out specs for shard_map(axis_names={'data'}): only the manual axis is
+    named; fsdp stays auto and rides the arrays' existing shardings."""
+
+    def leaf_spec(leaf):
+        dim = _data_dim(plan, np.shape(leaf), data_axis)
+        if dim is None:
+            return PartitionSpec()
+        spec = [None] * len(np.shape(leaf))
+        spec[dim] = data_axis
+        return PartitionSpec(*spec)
+
+    return jax.tree_util.tree_map(leaf_spec, tree)
+
+
+def _qwz_gather_dim(x, dim, axis_name, compute_dtype, group_size, quantize):
+    """All-gather a master shard over the slow axis along ``dim`` (tiled),
+    int8-quantized when ``quantize`` — the stage-3 qwZ gather into the hpZ
+    secondary copy."""
+    if not quantize or int(np.prod(x.shape)) < MIN_QUANT_SIZE:
+        return jax.lax.all_gather(x.astype(compute_dtype), axis_name, axis=dim, tiled=True)
+    stacked = quantized_allgather_int8(x.astype(compute_dtype), axis_name, group_size)
+    # [W, ...] -> tiled concat on dim
+    moved = jnp.moveaxis(stacked, 0, dim)
+    shape = list(x.shape)
+    shape[dim] = x.shape[dim] * stacked.shape[0]
+    return moved.reshape(shape)
+
+
+def _qgz_scatter_dim(g, dim, axis_name, group_size, quantize):
+    """Reduce-scatter a gradient leaf over the slow axis along ``dim``,
+    int4-quantized when ``quantize`` — the stage-3 qgZ hierarchical reduction
+    (the fsdp part of the reduction stays on GSPMD auto)."""
+    world = jax.lax.axis_size(axis_name)
+    perm = (dim, ) + tuple(d for d in range(g.ndim) if d != dim)
+    gt = g.transpose(perm)
+    lead = gt.shape[0]
+    flat = gt.reshape(-1)
+    if quantize and flat.shape[0] >= MIN_QUANT_SIZE and flat.shape[0] % (world * 2) == 0:
+        shard = quantized_psum_scatter_int4(flat, axis_name, group_size=group_size)
+    else:
+        shard = jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+    out_shape = (lead // world, ) + gt.shape[1:]
+    back = shard.reshape(out_shape).transpose(tuple(np.argsort(perm)))
+    return back / world  # mean over the data replicas
+
+
+def make_zpp3_grad_fn(loss_fn, mesh, plan, gas: int, *, qwz: bool, qgz: bool,
+                      compute_dtype, data_axis: str = "data", group_size: int = 2048):
+    """Build grads_fn(master, batch, micro_rngs, scale) -> (grads, loss_sum) for
+    ZeRO-3 with ZeRO++ quantized communication on the slow axis.
+
+    master: fp32, sharded over ('data','fsdp') per the plan.  Inside the manual
+    'data' context: qwZ int8 gather -> fsdp-sharded bf16 secondary copy (hpZ);
+    GSPMD per-layer gathers over fsdp during loss; qgZ int4 reduce-scatter of
+    grads back to the ('data','fsdp') master layout.  Returned grads are the dp
+    MEAN (divide only by gas*scale afterwards, matching the GSPMD path).
+    """
+
+    def wrapped(master, batch, micro_rngs, scale):
+        dims = jax.tree_util.tree_map(lambda x: _data_dim(plan, np.shape(x), data_axis), master)
+        master_specs = _manual_data_spec(plan, master, data_axis)
+        batch_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(None, data_axis), batch)
+        in_specs = (master_specs, batch_specs, PartitionSpec(), PartitionSpec())
+        out_specs = (master_specs, PartitionSpec())
+
+        def body(master, batch, micro_rngs, scale):
+            params16 = jax.tree_util.tree_map(
+                lambda x, d: x.astype(compute_dtype) if d is None else _qwz_gather_dim(
+                    x, d, data_axis, compute_dtype, group_size, qwz), master, dims)
+            grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, micro_rngs, scale)
+            grads = jax.tree_util.tree_map(
+                lambda g, d: jax.lax.pmean(g, data_axis) if d is None else _qgz_scatter_dim(
+                    g, d, data_axis, group_size, qgz), grads, dims)
+            return grads, jax.lax.pmean(loss_sum, data_axis)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                             axis_names={data_axis}, check_vma=False)(master, batch, micro_rngs, scale)
+
+    return wrapped
