@@ -104,6 +104,22 @@ class Engine:
         self.base_lr = float(opt_params.pop("lr", 1e-3))
         self.optimizer = optimizers.get_optimizer(opt_cfg.type if opt_cfg else "adamw", **opt_params)
 
+        # 1-bit optimizers: comm-coupled, so the engine owns their shard_map step
+        # (reference fp16/onebit/adam.py restricts to non-ZeRO dp; same here)
+        self._onebit = getattr(self.optimizer, "onebit", None)
+        self._onebit_world = 1
+        if self._onebit is not None:
+            pure = all(self.topology.axis_size(a) == 1
+                       for a in ("tensor", "sequence", "expert", "pipe"))
+            if self.zero_stage != 0 or not pure:
+                raise ValueError("1-bit optimizers require ZeRO stage 0 and a pure "
+                                 "data-parallel mesh (reference onebit/adam.py compat)")
+            if config.fp16.enabled:
+                raise ValueError("1-bit optimizers require bf16/fp32 compute (sign "
+                                 "compression would launder fp16 overflow)")
+            self._onebit_world = int(np.prod([self.topology.axis_size(a)
+                                              for a in self.plan.shard_axes]))
+
         # lr schedule
         sched_cfg = config.scheduler
         self.lr_schedule = lr_schedules.build_lr_schedule(sched_cfg.type if sched_cfg else None,
@@ -156,7 +172,7 @@ class Engine:
 
         def make_state(p):
             master = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
-            opt_state = self.optimizer.init(master)
+            opt_state = self._opt_init(master)
             ls = init_loss_scale(self.config.fp16) if self.fp16_enabled else None
             return TrainState(step=jnp.zeros((), jnp.int32),
                               params=master,
@@ -178,7 +194,7 @@ class Engine:
         def make_state():
             p = param_init_fn()
             master = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), p)
-            opt_state = self.optimizer.init(master)
+            opt_state = self._opt_init(master)
             ls = init_loss_scale(self.config.fp16) if self.fp16_enabled else None
             return TrainState(step=jnp.zeros((), jnp.int32),
                               params=master,
@@ -190,12 +206,31 @@ class Engine:
         shardings = self._state_shardings(shapes)
         return jax.jit(make_state, out_shardings=shardings)()
 
+    def _opt_init(self, master):
+        if self._onebit is not None:
+            return self._onebit.init(master, self._onebit_world)
+        return self.optimizer.init(master)
+
     def _state_shardings(self, state_shapes: TrainState) -> TrainState:
         rep = NamedSharding(self.topology.mesh, PartitionSpec())
+        opt = self.plan.opt_state_shardings(state_shapes.opt_state)
+        if self._onebit is not None and self._onebit_world > 1:
+            # error-feedback buffers are per-rank data: worker [world, npad]
+            # sharded on dim 0, server [npad] sharded (each rank its slice)
+            from .onebit import error_buffer_spec
+            axes = self.plan.shard_axes
+            ax = axes if len(axes) > 1 else axes[0]
+            mesh = self.topology.mesh
+
+            def fix(path, sharding):
+                spec = error_buffer_spec(path, ax)
+                return NamedSharding(mesh, spec) if spec is not None else sharding
+
+            opt = jax.tree_util.tree_map_with_path(fix, opt)
         return TrainState(
             step=rep,
             params=self.plan.master_shardings(state_shapes.params),
-            opt_state=self.plan.opt_state_shardings(state_shapes.opt_state),
+            opt_state=opt,
             loss_scale=jax.tree_util.tree_map(lambda _: rep, state_shapes.loss_scale),
             rng=rep,
         )
@@ -310,8 +345,9 @@ class Engine:
         hpz = (zero_cfg.zero_hpz_partition_size > 1 and self.zero_stage >= 3
                and topo.axis_size("fsdp") > 1)
         if zero_cfg.zero_quantized_gradients and not (qgz or zpp3):
-            log_dist("zero_quantized_gradients requested but inactive (needs pure dp/fsdp "
-                     "mesh with dp world > 1; stage 3 additionally needs data>1 AND fsdp>1)", ranks=[0])
+            log_dist("zero_quantized_gradients requested but inactive (needs bf16/fp32 "
+                     "compute — not fp16 — and a pure dp/fsdp mesh with dp world > 1; "
+                     "stage 3 additionally needs data>1 AND fsdp>1)", ranks=[0])
         if zero_cfg.zero_quantized_weights and not (qwz or zpp3):
             log_dist("zero_quantized_weights requested but inactive (needs pure dp/fsdp "
                      "mesh with dp world > 1; stage 3 additionally needs data>1 AND fsdp>1)", ranks=[0])
@@ -357,11 +393,29 @@ class Engine:
                                         qwz=bool(zero_cfg.zero_quantized_weights),
                                         qgz=bool(zero_cfg.zero_quantized_gradients),
                                         compute_dtype=compute_dtype)
+        onebit_fn = None
+        if self._onebit is not None and self._onebit_world > 1:
+            onebit_fn = self._make_onebit_step()
+            if clip_norm > 0:
+                log_dist("gradient_clipping is not applied on the 1-bit compressed "
+                         "path (reference onebit optimizers skip it)", ranks=[0])
 
         def train_step(state: TrainState, batch) -> Tuple[TrainState, StepMetrics]:
             rng, step_rng = jax.random.split(state.rng)
             scale = state.loss_scale.cur_scale if fp16 else jnp.float32(1.0)
             micro_rngs = jax.random.split(step_rng, gas)
+
+            if onebit_fn is not None:
+                # 1-bit optimizer: grads + compressed momentum reduction +
+                # update all inside one shard_map (comm is part of the step)
+                lr = lr_schedule(state.step)
+                new_params, new_opt, loss_sum, norm = onebit_fn(
+                    state.params, state.opt_state, batch, micro_rngs, lr)
+                new_state = TrainState(step=state.step + 1, params=new_params,
+                                       opt_state=new_opt, loss_scale=None, rng=rng)
+                return new_state, StepMetrics(loss=loss_sum / gas, grad_norm=norm, lr=lr,
+                                              skipped=jnp.zeros((), jnp.bool_),
+                                              loss_scale=jnp.float32(1.0))
 
             if zpp3_fn is not None:
                 # stage-3 ZeRO++: int8 gather + int4 hierarchical grad reduction
@@ -422,6 +476,47 @@ class Engine:
                        in_shardings=(shardings, None),
                        out_shardings=(shardings, None),
                        donate_argnums=(0, ))
+
+    def _make_onebit_step(self):
+        """shard_map step for 1-bit optimizers: local grads -> local momentum
+        update -> sign-compressed allreduce of the momentum -> param update
+        (reference fp16/onebit/adam.py:14 + runtime/comm/nccl.py:51)."""
+        spec = self._onebit
+        axes = self.plan.shard_axes
+        ax = axes if len(axes) > 1 else axes[0]
+        world = self._onebit_world
+        mesh = self.topology.mesh
+        gas = self.gradient_accumulation_steps
+        compute_dtype = self.compute_dtype
+        loss_fn = self.loss_fn
+        rep = PartitionSpec()
+
+        from .onebit import error_buffer_spec
+
+        def opt_spec(path, _):
+            spec = error_buffer_spec(path, ax)
+            return spec if spec is not None else rep
+
+        def body(master, opt_state, batch, micro_rngs, lr):
+            params16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), master)
+            grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, micro_rngs,
+                                                     jnp.float32(1.0))
+            grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+            # approximate norm: mean over ranks of the local-grad global norm
+            norm = jax.lax.pmean(global_grad_norm(grads), ax)
+            new_master, new_opt = spec.local_step(grads, opt_state, master, lr, ax, world)
+            return new_master, new_opt, jax.lax.pmean(loss_sum, ax), norm
+
+        def step(master, opt_state, batch, micro_rngs, lr):
+            rep_tree = lambda t: jax.tree_util.tree_map(lambda _: rep, t)
+            opt_specs = jax.tree_util.tree_map_with_path(opt_spec, opt_state)
+            batch_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(None, ax), batch)
+            in_specs = (rep_tree(master), opt_specs, batch_specs, rep, rep)
+            out_specs = (rep_tree(master), opt_specs, rep, rep)
+            return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=False)(master, opt_state, batch, micro_rngs, lr)
+
+        return step
 
     @property
     def train_step_fn(self):

@@ -31,6 +31,9 @@ class Optimizer:
     # flat-buffer kernel (ops/adam/fused_adam.py) does ONE aliased HBM pass —
     # the multi-tensor-apply analog (csrc/adam/multi_tensor_adam.cu).
     step_fn: Optional[Callable] = None
+    # 1-bit optimizers (runtime/onebit.py): comm-coupled local-step spec; the
+    # engine builds a shard_map train step around it (reference fp16/onebit/).
+    onebit: Optional[Any] = None
 
 
 def _tree_zeros_like(params, dtype=None):
@@ -255,9 +258,26 @@ _register(["adagrad"], lambda lr=None, **kw: adagrad(**_strip(kw)))
 _register(["lamb", "fusedlamb"], lambda lr=None, **kw: lamb(**_strip(kw)))
 
 
+def _onebit_builder(which):
+
+    def build(lr=None, **kw):
+        from . import onebit as _ob
+        return getattr(_ob, which)(**_strip(kw))
+
+    return build
+
+
+# reference spellings: ONEBIT_ADAM_OPTIMIZER 'onebitadam', ONEBIT_LAMB_OPTIMIZER
+# 'onebitlamb', ZERO_ONE_ADAM_OPTIMIZER 'zerooneadam' (runtime/config.py)
+_register(["onebitadam", "onebit_adam"], _onebit_builder("onebit_adam"))
+_register(["onebitlamb", "onebit_lamb"], _onebit_builder("onebit_lamb"))
+_register(["zerooneadam", "zero_one_adam"], _onebit_builder("zero_one_adam"))
+
+
 def _strip(kw):
     # Drop torch-style kwargs that don't map (e.g. torch_adam, fused flags).
-    drop = {"torch_adam", "fused", "cuda_aware", "adam_w_mode"}
+    drop = {"torch_adam", "fused", "cuda_aware", "adam_w_mode", "comm_backend_name",
+            "check_overflow", "pipeline_enabled"}
     out = {k: v for k, v in kw.items() if k not in drop}
     if "betas" in out:
         out["betas"] = tuple(out["betas"])

@@ -101,23 +101,24 @@ def test_onebit_allreduce_error_feedback_converges():
     gs = jax.random.normal(jax.random.PRNGKey(0), (8, n))
     ref = np.asarray(gs).mean(axis=0)
 
-    def body(g, e):
-        est, new_e = onebit_allreduce(g[0], e[0], "dp")
-        return est, new_e[None, :]
+    def body(g, e, se):
+        est, new_e, new_se = onebit_allreduce(g[0], e[0], "dp", se)
+        return est, new_e[None, :], new_se
 
-    f = shard_map(body, mesh=mesh, in_specs=(P("dp", None), P("dp", None)),
-                  out_specs=(P(None), P("dp", None)), check_vma=False)
-    est, err = f(gs, jnp.zeros((8, n)))
+    f = shard_map(body, mesh=mesh, in_specs=(P("dp", None), P("dp", None), P("dp")),
+                  out_specs=(P(None), P("dp", None), P("dp")), check_vma=False)
+    est, err, serr = f(gs, jnp.zeros((8, n)), jnp.zeros((n,)))
     # single step: correlated with true mean
     assert np.corrcoef(np.asarray(est), ref)[0, 1] > 0.5
-    # repeated reduction of the SAME gradient with error feedback -> converges
+    # repeated reduction of the SAME gradient with worker+server error feedback
+    # -> converges
     accum = np.zeros(n)
-    e = jnp.zeros((8, n))
-    for i in range(12):
-        est, e = f(gs, e)
+    e, se = jnp.zeros((8, n)), jnp.zeros((n,))
+    for i in range(24):
+        est, e, se = f(gs, e, se)
         accum += np.asarray(est)
     # time-averaged estimate approaches the true mean (error feedback property)
-    assert np.corrcoef(accum / 12, ref)[0, 1] > 0.97
+    assert np.corrcoef(accum / 24, ref)[0, 1] > 0.97
 
 
 # ------------------------------------------------------------------ PLD + eig
