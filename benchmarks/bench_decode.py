@@ -1,0 +1,55 @@
+"""Decode-throughput microbench for the v2 ragged engine (FastGen analog).
+
+Run manually on a TPU host: `python benchmarks/bench_decode.py`.  Prints
+steady-state decode tokens/sec for a llama-class model served through
+InferenceEngineV2 (Pallas paged attention on TPU).
+"""
+
+import json
+import time
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_seqs, prompt_len, decode_steps = 32, 256, 64
+        num_blocks, block_size, maxb = 2048, 32, 64
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=128)
+        n_seqs, prompt_len, decode_steps = 4, 16, 4
+        num_blocks, block_size, maxb = 64, 8, 8
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(llama, cfg, params, config={"dtype": "bfloat16" if on_tpu else "float32"},
+                            num_blocks=num_blocks, block_size=block_size,
+                            max_blocks_per_seq=maxb, token_budget=1024,
+                            max_seqs_per_step=n_seqs)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(n_seqs)]
+    eng.put(list(range(n_seqs)), prompts)
+    while True:  # prefill until every sequence has emitted its first token
+        out = eng.step()
+        if len(out) == n_seqs:
+            break
+    for _ in range(3):  # decode warmup
+        eng.step()
+    t0 = time.perf_counter()
+    produced = 0
+    for _ in range(decode_steps):
+        produced += len(eng.step())
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "v2_decode_tokens_per_sec", "value": round(produced / dt, 1),
+                      "extra": {"n_seqs": n_seqs, "prompt_len": prompt_len,
+                                "params_m": round(llama.num_params(cfg) / 1e6, 1)}}))
+
+
+if __name__ == "__main__":
+    main()

@@ -343,33 +343,40 @@ def config_from_hf(hf_config) -> LlamaConfig:
 # --------------------------------------------------------- paged (ragged) serve
 def init_paged_cache(config: LlamaConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
     """Paged KV pool (reference inference/v2/ragged blocked KV layout):
-    [L, num_blocks, block_size, KV, Dh].  The last block is reserved as a trash
-    target for padded-token writes."""
+    [L, num_blocks, KV, block_size, Dh] — heads-major so the Pallas paged
+    kernel's trailing (block_size, Dh) tile satisfies TPU tiling.  The last
+    block is reserved as a trash target for padded-token writes."""
     L, KV = config.num_layers, config.num_kv_heads
     Dh = config.hidden_size // config.num_heads
     return {
-        "k": jnp.zeros((L, num_blocks, block_size, KV, Dh), dtype),
-        "v": jnp.zeros((L, num_blocks, block_size, KV, Dh), dtype),
+        "k": jnp.zeros((L, num_blocks, KV, block_size, Dh), dtype),
+        "v": jnp.zeros((L, num_blocks, KV, block_size, Dh), dtype),
     }
 
 
 def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
+                  kv_cache, *, block_size: int, window: Optional[int] = None):
     """Ragged chunked forward over the paged KV pool (FastGen model-forward
     analog, inference/v2/model_implementations/llama_v2 + blocked flash).
 
     tokens [N, T] (right-padded chunks), n_tokens [N] valid counts,
     start_pos [N] absolute start of this chunk, block_tables [N, MAXB]
-    (padded entries point at the trash block).  Returns (logits [N, T, V],
-    new kv_cache).
+    (padded entries point at the trash block).  ``window`` enables Mistral-style
+    sliding-window attention.  Returns (logits [N, T, V], new kv_cache).
+
+    Attention runs in the Pallas paged kernel (ops/attention/paged.py) on TPU —
+    only live blocks are read via scalar-prefetched table indices; off-TPU the
+    identical-math dense-gather fallback runs.
     """
+    from ..ops.attention.paged import paged_attention
+
     b, tchunk = tokens.shape
-    maxb = block_tables.shape[1]
     trash = kv_cache["k"].shape[1] - 1
     cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
     positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]  # [N, T]
     valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
     safe_pos = jnp.where(valid, positions, 0)
+    lengths = start_pos + n_tokens
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     H, KV = config.num_heads, config.num_kv_heads
     Dh = config.hidden_size // H
@@ -378,10 +385,7 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
     blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
     blk = jnp.where(valid, blk, trash)
     off = jnp.where(valid, safe_pos % block_size, 0)
-
-    kpos = jnp.arange(maxb * block_size)[None, None, :]  # [1, 1, MAXB*bs]
-    qpos = positions[:, :, None]  # [N, T, 1]
-    attn_mask = (kpos <= qpos) & valid[:, :, None]  # causal over absolute positions
+    head_idx = jnp.arange(KV)[None, None, :]
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -391,12 +395,11 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
         v = (attn_in @ lp["attn"]["wv"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
         q = apply_rotary(q, cos, sin, safe_pos)
         k = apply_rotary(k, cos, sin, safe_pos)
-        kpool = kpool.at[blk, off].set(k)
-        vpool = vpool.at[blk, off].set(v)
-        # gather each sequence's context blocks -> [N, MAXB*bs, KV, Dh]
-        ctx_k = kpool[block_tables].reshape(b, maxb * block_size, KV, Dh)
-        ctx_v = vpool[block_tables].reshape(b, maxb * block_size, KV, Dh)
-        out = sdpa(q, ctx_k, ctx_v, causal=False, mask=attn_mask[:, None, :, :], softmax_scale=scale)
+        # pool [NB, KV, bs, Dh]: pool[blk, h, off] = k[n, t, h]
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale, window=window)
         x = x + out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype)
         mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         x = x + swiglu_mlp(lp["mlp"], mlp_in)

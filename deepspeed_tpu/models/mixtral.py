@@ -124,3 +124,130 @@ def make_loss_fn(config: MixtralConfig, attention_fn=None, topo=None) -> Callabl
         return lm + config.aux_loss_coef * aux, {"aux_loss": aux}
 
     return loss_fn
+
+
+# --------------------------------------------------------- paged (ragged) serve
+def dense_moe_ffn(moe_params, x, top_k: int):
+    """Serving-time MoE FFN: top-k routing with NO capacity dropping (the
+    reference's ragged moe_gather/moe_scatter semantics,
+    inference/v2/kernels/ragged_ops/moe_*): every token reaches its k experts.
+
+    Dense formulation: compute all experts, combine with the (renormalized)
+    top-k gate weights — exact at any batch size; a megablox-style grouped GEMM
+    is the later perf upgrade for many-expert configs.
+    """
+    ex = moe_params["experts"]
+    gate_logits = x @ moe_params["gate"]["wg"].astype(x.dtype)  # [.., E]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, top_k)
+    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    combine = jnp.zeros_like(probs).at[
+        jnp.arange(probs.shape[0])[:, None], top_idx].set(top_p)  # [T, E]
+
+    def one_expert(wg, wu, wd):
+        h = jax.nn.silu(x @ wg.astype(x.dtype)) * (x @ wu.astype(x.dtype))
+        return h @ wd.astype(x.dtype)
+
+    all_out = jax.vmap(one_expert)(ex["w_gate"], ex["w_up"], ex["w_down"])  # [E, T, D]
+    return jnp.einsum("te,etd->td", combine.astype(x.dtype), all_out)
+
+
+def from_hf_state_dict(config: MixtralConfig, state_dict, dtype=jnp.float32):
+    """Convert a HF MixtralForCausalLM state dict (block_sparse_moe naming:
+    w1=gate, w3=up, w2=down) to our stacked pytree."""
+    def t(name):
+        w = state_dict[name]
+        return w.float().numpy() if hasattr(w, "float") else np.asarray(w, dtype=np.float32)
+
+    L, E = config.num_layers, config.num_experts
+    stack = lambda fmt, tr=True: jnp.asarray(
+        np.stack([(t(fmt.format(i)).T if tr else t(fmt.format(i))) for i in range(L)]), dtype)
+
+    def stack_expert(which):
+        return jnp.asarray(np.stack([
+            np.stack([t(f"model.layers.{i}.block_sparse_moe.experts.{e}.{which}.weight").T
+                      for e in range(E)]) for i in range(L)]), dtype)
+
+    return {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "attn": {
+                "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+                "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+                "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+                "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            },
+            "moe": {
+                "gate": {"wg": stack("model.layers.{}.block_sparse_moe.gate.weight")},
+                "experts": {"w_gate": stack_expert("w1"), "w_up": stack_expert("w3"),
+                            "w_down": stack_expert("w2")},
+            },
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", tr=False),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", tr=False),
+        },
+        "final_norm": jnp.asarray(t("model.norm.weight"), dtype),
+        "lm_head": jnp.asarray(t("lm_head.weight").T, dtype),
+    }
+
+
+def _llama_view(config: MixtralConfig):
+    from .llama import LlamaConfig
+    return LlamaConfig(vocab_size=config.vocab_size, hidden_size=config.hidden_size,
+                       intermediate_size=config.intermediate_size, num_layers=config.num_layers,
+                       num_heads=config.num_heads, num_kv_heads=config.num_kv_heads,
+                       max_seq_len=config.max_seq_len, rope_theta=config.rope_theta,
+                       rms_eps=config.rms_eps)
+
+
+def init_paged_cache(config: MixtralConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    from . import llama
+    return llama.init_paged_cache(_llama_view(config), num_blocks, block_size, dtype=dtype)
+
+
+def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked forward (reference inference/v2/model_implementations/
+    mixtral): llama-style paged attention + no-drop top-k MoE FFN per layer."""
+    from ..ops.attention.paged import paged_attention
+    from .transformer import apply_rotary
+
+    b, tchunk = tokens.shape
+    trash = kv_cache["k"].shape[1] - 1
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len,
+                             config.rope_theta)
+    positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]
+    valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
+    safe_pos = jnp.where(valid, positions, 0)
+    lengths = start_pos + n_tokens
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+    blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
+    blk = jnp.where(valid, blk, trash)
+    off = jnp.where(valid, safe_pos % block_size, 0)
+    head_idx = jnp.arange(KV)[None, None, :]
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        attn_in = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = (attn_in @ lp["attn"]["wq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (attn_in @ lp["attn"]["wk"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        v = (attn_in @ lp["attn"]["wv"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        q = apply_rotary(q, cos, sin, safe_pos)
+        k = apply_rotary(k, cos, sin, safe_pos)
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale)
+        x = x + out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype)
+        moe_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        flat = moe_in.reshape(b * tchunk, config.hidden_size)
+        moe_out = dense_moe_ffn(lp["moe"], flat, config.top_k)
+        x = x + moe_out.reshape(b, tchunk, config.hidden_size)
+        return x, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    logits = x @ params["lm_head"].astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

@@ -3,6 +3,7 @@ manager, SplitFuse scheduling, and end-to-end ragged generation parity with
 the dense v1 cache path."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -80,3 +81,96 @@ def test_splitfuse_long_prompt_across_steps():
     assert 0 in out
     out2 = eng.step()         # pure decode step
     assert 0 in out2
+
+
+# ------------------------------------------------------- paged Pallas kernel
+def test_paged_attention_kernel_parity():
+    """Blocked kernel (interpret mode) == dense-gather fallback, with and
+    without sliding window and with padding rows."""
+    from deepspeed_tpu.ops import _pallas
+    from deepspeed_tpu.ops.attention.paged import _dense_fallback, paged_attention
+    rng = np.random.default_rng(0)
+    N, T, H, KV, Dh, NB, BS, MAXB = 3, 4, 4, 2, 32, 16, 8, 4
+    q = jnp.asarray(rng.normal(size=(N, T, H, Dh)), jnp.float32)
+    kpool = jnp.asarray(rng.normal(size=(NB, BS, KV, Dh)), jnp.float32)
+    vpool = jnp.asarray(rng.normal(size=(NB, BS, KV, Dh)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, NB - 1, (N, MAXB)), jnp.int32)
+    lengths = jnp.asarray([5, 20, 31], jnp.int32)
+    qpos = jnp.stack([jnp.arange(T) + (l - T) for l in [5, 20, 31]]).astype(jnp.int32)
+    qpos = qpos.at[0, 3].set(-1)  # padding row
+    scale = 1.0 / np.sqrt(Dh)
+    old = _pallas.INTERPRET
+    _pallas.INTERPRET = True
+    try:
+        for window in (None, 6):
+            ref = _dense_fallback(q, kpool, vpool, tables, lengths, qpos, scale, window)
+            got = paged_attention(q, kpool, vpool, tables, lengths, qpos,
+                                  block_size=BS, window=window)
+            valid = np.asarray(qpos) >= 0
+            np.testing.assert_allclose(np.asarray(got)[valid], np.asarray(ref)[valid],
+                                       atol=2e-5)
+    finally:
+        _pallas.INTERPRET = old
+
+
+# ------------------------------------------------------------- mistral v2
+def test_mistral_v2_ragged_consistent_and_windowed():
+    """Mistral serves through v2 with the window applied: ragged multi-seq
+    generation == one-seq-at-a-time generation (scheduling invariance)."""
+    from deepspeed_tpu.models import mistral
+    cfg = mistral.MistralConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                     kv_heads=2, seq=128, window=8)
+    params = mistral.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3, 4, 5, 6, 7], [9, 10, 11], list(range(20, 32))]
+    eng = InferenceEngineV2(mistral, cfg, params, config={"dtype": "float32"},
+                            num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                            token_budget=16, max_seqs_per_step=4)
+    ragged = eng.generate(prompts, max_new_tokens=5)
+    for prompt, got in zip(prompts, ragged):
+        solo = InferenceEngineV2(mistral, cfg, params, config={"dtype": "float32"},
+                                 num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                                 token_budget=16, max_seqs_per_step=4)
+        ref = solo.generate([prompt], max_new_tokens=5)[0]
+        assert got == ref, (prompt, got, ref)
+    # the window matters: an unwindowed model diverges on the long prompt
+    cfg_nw = mistral.MistralConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                        kv_heads=2, seq=128, window=None)
+    eng_nw = InferenceEngineV2(mistral, cfg_nw, params, config={"dtype": "float32"},
+                               num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                               token_budget=16, max_seqs_per_step=4)
+    nw = eng_nw.generate([list(range(20, 32))], max_new_tokens=5)[0]
+    assert isinstance(nw, list)  # runs; (values may or may not differ on a tiny model)
+
+
+# ------------------------------------------------------------- mixtral v2
+def test_mixtral_v2_ragged_generation():
+    """Mixtral (MoE) serves through v2: ragged == solo generation, finite."""
+    from deepspeed_tpu.models import mixtral
+    cfg = mixtral.MixtralConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                     kv_heads=2, experts=4, seq=128)
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = [[1, 2, 3, 4, 5], [9, 10, 11, 12, 13, 14, 15]]
+    eng = InferenceEngineV2(mixtral, cfg, params, config={"dtype": "float32"},
+                            num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                            token_budget=16, max_seqs_per_step=4)
+    ragged = eng.generate(prompts, max_new_tokens=5)
+    for prompt, got in zip(prompts, ragged):
+        assert len(got) == len(prompt) + 5
+        solo = InferenceEngineV2(mixtral, cfg, params, config={"dtype": "float32"},
+                                 num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                                 token_budget=16, max_seqs_per_step=4)
+        ref = solo.generate([prompt], max_new_tokens=5)[0]
+        assert got == ref
+
+
+def test_engine_factory_registry():
+    from deepspeed_tpu.inference.v2.engine_factory import build_engine
+    from deepspeed_tpu.models import mistral
+    cfg = mistral.MistralConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2)
+    params = mistral.init_params(cfg, jax.random.PRNGKey(0))
+    eng = build_engine("mistral", cfg, params, config={"dtype": "float32"},
+                       num_blocks=16, block_size=8, max_blocks_per_seq=4)
+    out = eng.generate([[1, 2, 3]], max_new_tokens=2)
+    assert len(out[0]) == 5
+    with pytest.raises(ValueError, match="v2 serving supports"):
+        build_engine("falcon", cfg, params)
