@@ -92,21 +92,22 @@ def test_paged_attention_kernel_parity():
     rng = np.random.default_rng(0)
     N, T, H, KV, Dh, NB, BS, MAXB = 3, 4, 4, 2, 32, 16, 8, 4
     q = jnp.asarray(rng.normal(size=(N, T, H, Dh)), jnp.float32)
-    kpool = jnp.asarray(rng.normal(size=(NB, BS, KV, Dh)), jnp.float32)
-    vpool = jnp.asarray(rng.normal(size=(NB, BS, KV, Dh)), jnp.float32)
+    kpool = jnp.asarray(rng.normal(size=(NB, KV, BS, Dh)), jnp.float32)
+    vpool = jnp.asarray(rng.normal(size=(NB, KV, BS, Dh)), jnp.float32)
     tables = jnp.asarray(rng.integers(0, NB - 1, (N, MAXB)), jnp.int32)
     lengths = jnp.asarray([5, 20, 31], jnp.int32)
-    qpos = jnp.stack([jnp.arange(T) + (l - T) for l in [5, 20, 31]]).astype(jnp.int32)
-    qpos = qpos.at[0, 3].set(-1)  # padding row
+    n_tokens = jnp.asarray([3, 4, 4], jnp.int32)  # seq 0 has a padding row
+    start_pos = lengths - n_tokens
     scale = 1.0 / np.sqrt(Dh)
     old = _pallas.INTERPRET
     _pallas.INTERPRET = True
     try:
         for window in (None, 6):
-            ref = _dense_fallback(q, kpool, vpool, tables, lengths, qpos, scale, window)
-            got = paged_attention(q, kpool, vpool, tables, lengths, qpos,
-                                  block_size=BS, window=window)
-            valid = np.asarray(qpos) >= 0
+            ref = _dense_fallback(q, kpool, vpool, tables, lengths, start_pos,
+                                  n_tokens, scale, window)
+            got = paged_attention(q, kpool, vpool, tables, lengths, start_pos,
+                                  n_tokens, block_size=BS, window=window)
+            valid = np.asarray(jnp.arange(T)[None, :] < n_tokens[:, None])
             np.testing.assert_allclose(np.asarray(got)[valid], np.asarray(ref)[valid],
                                        atol=2e-5)
     finally:
