@@ -1,0 +1,15 @@
+"""Block-sparse attention for TPU.
+
+The reference ships a Triton blocksparse stack (deepspeed/ops/sparse_attention/:
+matmul.py SDD/DSD kernels, softmax.py, sparsity_config.py, and the
+SparseSelfAttention / BertSparseSelfAttention modules).  Here the same
+capability is a single fused Pallas kernel (attention.py) driven by the same
+family of block-layout generators (sparsity_config.py) — on TPU there is no
+reason to split QK^T / softmax / PV into three kernels, the fused online-softmax
+form is strictly better (no materialised block-sparse score tensor in HBM).
+"""
+
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+                              VariableSparsityConfig, BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig, LocalSlidingWindowSparsityConfig)
+from .attention import sparse_attention, make_sparse_attention_fn, pad_to_block_size

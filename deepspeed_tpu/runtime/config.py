@@ -213,6 +213,71 @@ class MeshConfig(ConfigModel):
         return {a: getattr(self, a) for a in ("data", "fsdp", "tensor", "sequence", "expert", "pipe")}
 
 
+class SparseAttentionConfig(ConfigModel):
+    """Blocksparse attention section (reference runtime/config.py:286
+    ``get_sparse_attention`` — mode + per-mode knobs).  ``build(num_heads)``
+    resolves the matching SparsityConfig from ops/sparse_attention."""
+    mode: str = Field("fixed", choices=("dense", "fixed", "variable", "bigbird", "bslongformer", "local"))
+    block: int = Field(16, ge=8)  # must be a multiple of 8 (TPU sublane); see model_validate
+    different_layout_per_head: bool = False
+    # fixed / variable
+    num_local_blocks: int = Field(4, ge=1)
+    num_global_blocks: int = Field(1, ge=1)
+    # None -> per-mode default: "unidirectional" for local (the causal Mistral
+    # pattern is that class's own default), "bidirectional" elsewhere.
+    attention: Optional[str] = Field(None, choices=(None, "unidirectional", "bidirectional"))
+    horizontal_global_attention: bool = False
+    num_different_global_patterns: int = Field(1, ge=1)
+    # variable / bigbird; None -> per-mode default (bigbird: 1, variable: 0),
+    # matching each reference class's own constructor default.
+    num_random_blocks: Optional[int] = Field(None, ge=0)
+    local_window_blocks: Optional[List[int]] = None
+    global_block_indices: Optional[List[int]] = None
+    global_block_end_indices: Optional[List[int]] = None
+    # bigbird / bslongformer / local
+    num_sliding_window_blocks: int = Field(3, ge=1)
+
+    def model_validate(self):
+        if self.block % 8 != 0:
+            raise ValueError(
+                f"sparse_attention.block={self.block} must be a multiple of 8 — the "
+                f"Pallas kernel tiles on the TPU sublane; non-multiples silently hit "
+                f"the O(S^2) dense fallback")
+
+    def build(self, num_heads: int):
+        from ..ops.sparse_attention import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                                            DenseSparsityConfig, FixedSparsityConfig,
+                                            LocalSlidingWindowSparsityConfig,
+                                            VariableSparsityConfig)
+        attention = self.attention or ("unidirectional" if self.mode == "local" else "bidirectional")
+        if self.mode == "dense":
+            return DenseSparsityConfig(num_heads, self.block, self.different_layout_per_head)
+        if self.mode == "fixed":
+            return FixedSparsityConfig(
+                num_heads, self.block, self.different_layout_per_head,
+                self.num_local_blocks, self.num_global_blocks, attention,
+                self.horizontal_global_attention, self.num_different_global_patterns)
+        if self.mode == "variable":
+            return VariableSparsityConfig(
+                num_heads, self.block, self.different_layout_per_head,
+                self.num_random_blocks or 0, self.local_window_blocks,
+                self.global_block_indices, self.global_block_end_indices,
+                attention, self.horizontal_global_attention)
+        if self.mode == "bigbird":
+            num_random = self.num_random_blocks if self.num_random_blocks is not None else 1
+            return BigBirdSparsityConfig(
+                num_heads, self.block, self.different_layout_per_head,
+                num_random, self.num_sliding_window_blocks,
+                self.num_global_blocks, attention)
+        if self.mode == "bslongformer":
+            return BSLongformerSparsityConfig(
+                num_heads, self.block, self.different_layout_per_head,
+                self.num_sliding_window_blocks, self.global_block_indices,
+                self.global_block_end_indices, attention)
+        return LocalSlidingWindowSparsityConfig(
+            num_heads, self.block, self.num_sliding_window_blocks, attention)
+
+
 class GradientCompressionConfig(ConfigModel):
     """1-bit style compressed gradient reduction (reference runtime/comm/nccl.py:51)."""
     enabled: bool = False
@@ -259,6 +324,7 @@ class TrainingConfig(ConfigModel):
     flops_profiler: FlopsProfilerConfig = Field(FlopsProfilerConfig)
     mesh: MeshConfig = Field(MeshConfig)
     gradient_compression: GradientCompressionConfig = Field(GradientCompressionConfig)
+    sparse_attention: Optional[SparseAttentionConfig] = None
     data_efficiency: DataEfficiencyConfig = Field(DataEfficiencyConfig)
 
     wall_clock_breakdown: bool = False

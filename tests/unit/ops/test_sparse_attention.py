@@ -1,0 +1,223 @@
+"""Blocksparse attention: layout semantics + kernel parity vs dense-masked sdpa.
+
+Mirrors the reference's sparse-attention tests (tests/unit/ops/sparse_attention/
+test_sparse_attention.py — Triton kernels vs dense torch baseline); here the
+baseline is XLA sdpa with the layout expanded to an element mask, and the
+kernel runs in Pallas interpreter mode on CPU.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import sdpa
+from deepspeed_tpu.ops import _pallas
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, VariableSparsityConfig,
+    make_sparse_attention_fn, pad_to_block_size, sparse_attention)
+from deepspeed_tpu.ops.sparse_attention.attention import _layout_element_mask
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(_pallas, "INTERPRET", True)
+
+
+# ------------------------------------------------------------- layout semantics
+def test_dense_layout_is_full():
+    lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+    assert lay.shape == (2, 4, 4)
+    assert lay.all()
+
+
+def test_fixed_local_windows_bidirectional():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                              num_global_blocks=1, attention="bidirectional")
+    lay = cfg.make_layout(16 * 6)[0]
+    # window [0,1]: full 2x2 block square
+    assert lay[0, 1] == 1 and lay[1, 0] == 1
+    # global column = last block of each window (block 1, 3, 5) visible to all rows
+    for g in (1, 3, 5):
+        assert lay[:, g].all()
+    # non-global, non-local cell dead: row 0 cannot see block 2 (local window [2,3])
+    assert lay[0, 2] == 0
+
+
+def test_fixed_unidirectional_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    lay = cfg.make_layout(16 * 8)[0]
+    assert np.triu(lay, k=1).sum() == 0
+
+
+def test_fixed_different_global_patterns_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              num_global_blocks=1, different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    lay = cfg.make_layout(16 * 8)
+    # head h uses global block (num_local - 1 - h) within each window
+    for h in range(4):
+        g = 3 - h
+        assert lay[h, :, g].all()
+    assert not np.array_equal(lay[0], lay[1])
+
+
+def test_bigbird_components():
+    random.seed(7)
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    lay = cfg.make_layout(16 * 8)[0]
+    # global first row/col + sliding diagonal band
+    assert lay[0, :].all() and lay[:, 0].all()
+    r, c = np.meshgrid(np.arange(8), np.arange(8), indexing="ij")
+    assert lay[np.abs(r - c) <= 1].all()
+    # each row has >= 1 random block beyond structure (can't assert position)
+    assert lay.sum(axis=1).min() >= 1
+
+
+def test_bigbird_unidirectional_tril():
+    random.seed(3)
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, attention="unidirectional")
+    lay = cfg.make_layout(16 * 6)[0]
+    assert np.triu(lay, k=1).sum() == 0
+
+
+def test_longformer_global_ranges():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 2],
+                                     global_block_end_indices=[1, 4])
+    lay = cfg.make_layout(16 * 8)[0]
+    for g in (0, 2, 3):
+        assert lay[g, :].all() and lay[:, g].all()
+
+
+def test_variable_layout_locals_and_global():
+    random.seed(0)
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=0,
+                                 local_window_blocks=[1, 2],
+                                 global_block_indices=[0])
+    lay = cfg.make_layout(16 * 6)[0]
+    assert lay[:, 0].all()          # global col 0
+    assert lay[1, 2] == 1 and lay[2, 1] == 1   # window [1,2]
+    # remaining rows use last width (2): windows [3,4], [5]
+    assert lay[3, 4] == 1 and lay[4, 3] == 1
+    assert lay[1, 3] == 0
+
+
+def test_local_sliding_window_unidirectional():
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=16,
+                                           num_sliding_window_blocks=3)
+    lay = cfg.make_layout(16 * 6)
+    assert np.triu(lay[0], k=1).sum() == 0
+    assert lay[0][3, 2] == 1 and lay[0][3, 1] == 0  # w = 1 back-window
+    assert np.array_equal(lay[0], lay[1])
+
+
+# --------------------------------------------------------------- kernel parity
+def _qkv(key, b, s, hq, hk, d):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (b, s, hq, d)),
+            jax.random.normal(kk, (b, s, hk, d)),
+            jax.random.normal(kv, (b, s, hk, d)))
+
+
+def _dense_ref(q, k, v, layout, block, causal):
+    lm = _layout_element_mask(np.asarray(layout), block, q.shape[1], q.shape[2])
+    return sdpa(q, k, v, causal=causal, mask=lm)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_dense_fixed(causal):
+    attn = "unidirectional" if causal else "bidirectional"
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                              num_global_blocks=1, attention=attn)
+    lay = cfg.make_layout(128)
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 128, 4, 4, 32)
+    out = sparse_attention(q, k, v, lay, 16, causal=causal)
+    ref = _dense_ref(q, k, v, lay, 16, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_matches_dense_bigbird_gqa():
+    random.seed(11)
+    cfg = BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    lay = cfg.make_layout(96)
+    q, k, v = _qkv(jax.random.PRNGKey(2), 1, 96, 4, 2, 16)
+    out = sparse_attention(q, k, v, lay, 16, causal=False)
+    ref = _dense_ref(q, k, v, lay, 16, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_handles_unpadded_seq():
+    """Seq shorter than NB*block: pad rows masked, outputs match dense."""
+    cfg = BSLongformerSparsityConfig(num_heads=2, block=16)
+    lay = cfg.make_layout(80)
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 72, 2, 2, 16)
+    out = sparse_attention(q, k, v, lay, 16, causal=False)
+    ref = _dense_ref(q, k, v, lay, 16, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_gradients_match_dense():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              attention="unidirectional")
+    lay = cfg.make_layout(64)
+    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 64, 2, 2, 16)
+
+    def loss_sparse(q, k, v):
+        return jnp.sum(sparse_attention(q, k, v, lay, 16, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_ref(q, k, v, lay, 16, True) ** 2)
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_attention_fn_injection():
+    """make_sparse_attention_fn plugs into attention_block's attention_fn slot."""
+    from deepspeed_tpu.models import transformer as T
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=16,
+                                           num_sliding_window_blocks=3)
+    attn_fn = make_sparse_attention_fn(cfg, max_seq_length=128)
+    key = jax.random.PRNGKey(5)
+    dm, nh, s = 32, 2, 64
+    params = {
+        "wq": jax.random.normal(key, (dm, dm)) * 0.05,
+        "wk": jax.random.normal(key, (dm, dm)) * 0.05,
+        "wv": jax.random.normal(key, (dm, dm)) * 0.05,
+        "wo": jax.random.normal(key, (dm, dm)) * 0.05,
+    }
+    cos, sin = T.rotary_tables(dm // nh, 128)
+    x = jax.random.normal(jax.random.PRNGKey(6), (2, s, dm))
+    out, _ = T.attention_block(params, x, n_heads=nh, n_kv_heads=nh, cos=cos,
+                               sin=sin, causal=True, attention_fn=attn_fn)
+    assert out.shape == (2, s, dm)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_pad_to_block_size():
+    x = jnp.ones((2, 30), jnp.int32)
+    padded, pad = pad_to_block_size(16, x)
+    assert padded.shape == (2, 32) and pad == 2
+    same, none = pad_to_block_size(16, padded)
+    assert none == 0 and same.shape == (2, 32)
+
+
+def test_self_attention_only():
+    """sq != sk (decode with a KV cache) must raise loudly, not silently
+    compute dense attention — reference scope (sparse_self_attention.py:121)."""
+    q = jnp.ones((1, 8, 2, 16))
+    k = jnp.ones((1, 32, 2, 16))
+    v = jnp.ones((1, 32, 2, 16))
+    lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(32)
+    with pytest.raises(NotImplementedError):
+        sparse_attention(q, k, v, lay, 16, causal=False)

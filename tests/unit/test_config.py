@@ -116,3 +116,39 @@ def test_type_coercion():
     z = ZeroConfig(reduce_bucket_size=5e8, stage="2")
     assert z.reduce_bucket_size == int(5e8)
     assert z.stage == 2
+
+
+def test_sparse_attention_section():
+    cfg = load_config({
+        "train_batch_size": 8,
+        "sparse_attention": {
+            "mode": "bigbird",
+            "block": 16,
+            "num_random_blocks": 1,
+            "num_sliding_window_blocks": 3,
+            "num_global_blocks": 1,
+        },
+    })
+    from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+    sc = cfg.sparse_attention.build(num_heads=4)
+    assert isinstance(sc, BigBirdSparsityConfig)
+    assert sc.make_layout(64).shape == (4, 4, 4)
+
+
+def test_sparse_attention_mode_validation():
+    with pytest.raises((ValueError, TypeError)):
+        load_config({"train_batch_size": 8, "sparse_attention": {"mode": "nope"}})
+
+
+def test_sparse_attention_per_mode_defaults():
+    # local mode defaults to the class's own unidirectional (causal) pattern
+    cfg = load_config({"train_batch_size": 8, "sparse_attention": {"mode": "local"}})
+    assert cfg.sparse_attention.build(2).attention == "unidirectional"
+    # bigbird keeps its reference default of 1 random block when unset
+    cfg = load_config({"train_batch_size": 8, "sparse_attention": {"mode": "bigbird"}})
+    assert cfg.sparse_attention.build(2).num_random_blocks == 1
+    # explicit values still win
+    cfg = load_config({"train_batch_size": 8, "sparse_attention": {
+        "mode": "bigbird", "num_random_blocks": 0, "attention": "unidirectional"}})
+    sc = cfg.sparse_attention.build(2)
+    assert sc.num_random_blocks == 0 and sc.attention == "unidirectional"
