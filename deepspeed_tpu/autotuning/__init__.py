@@ -1,0 +1,15 @@
+"""Autotuning — search over (ZeRO stage, micro-batch, remat policy, ZeRO++
+knobs) with short measured runs, emitting the best engine config.
+
+TPU-native analog of the reference autotuner (deepspeed/autotuning/
+autotuner.py:42 ``Autotuner``, scheduler.py:33 ``ResourceManager``,
+tuner/{base_tuner,index_based_tuner,model_based_tuner}.py): where the
+reference schedules whole launcher sub-jobs per experiment, here one process
+re-jits the train step per candidate config (XLA recompile ~= the reference's
+process relaunch, but cheaper and in-process) and measures steady-state step
+time on the live mesh.
+"""
+
+from .autotuner import Autotuner, ModelInfo
+from .config import AutotuningConfig
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner

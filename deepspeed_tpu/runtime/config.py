@@ -334,7 +334,7 @@ class TrainingConfig(ConfigModel):
     load_universal_checkpoint: bool = False
     use_node_local_storage: bool = False
     elasticity: Optional[Dict[str, Any]] = None
-    autotuning: Optional[Dict[str, Any]] = None
+    autotuning: Optional[Dict[str, Any]] = None  # parsed by autotuning.AutotuningConfig
 
     def model_validate(self):
         if self.fp16.enabled and self.bf16 is not None and self.bf16.enabled:

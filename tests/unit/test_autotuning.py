@@ -1,0 +1,211 @@
+"""Autotuner: memory pruning, tuner strategies, end-to-end search with a
+stubbed runner, and a real measured run through the engine.
+
+Reference analog: tests/unit/autotuning/test_autotuning.py (experiment
+generation / resource manager); here the search loop runs in-process so the
+whole flow is testable without a launcher.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, AutotuningConfig, GridSearchTuner,
+                                      ModelBasedTuner, ModelInfo, RandomTuner)
+from deepspeed_tpu.autotuning.autotuner import model_state_memory
+
+GiB = 1 << 30
+
+
+# ------------------------------------------------------------- memory model
+def test_model_state_memory_by_stage():
+    p = 1_000_000
+    full = model_state_memory(p, 0, dp_size=8)
+    assert full == p * (2 + 2 + 12)
+    assert model_state_memory(p, 1, 8) == p * 2 + p * 2 + p * 12 // 8
+    assert model_state_memory(p, 2, 8) == p * 2 + (p * 2 + p * 12) // 8
+    assert model_state_memory(p, 3, 8) == p * 16 // 8
+    # monotone decreasing in stage
+    mems = [model_state_memory(p, s, 8) for s in range(4)]
+    assert mems == sorted(mems, reverse=True)
+
+
+def test_feasibility_pruning():
+    # 1B params: stage 0 needs 16 GB, stage 3 (dp=8) needs 2 GB
+    info = ModelInfo(num_params=1_000_000_000, activation_mem_per_mbs=1 * GiB)
+    at = Autotuner(info, runner=lambda e: None, dp_size=8, device_memory=4 * GiB)
+    assert at.feasible_stages() == [3]
+    at = Autotuner(info, runner=lambda e: None, dp_size=8, device_memory=32 * GiB)
+    assert at.feasible_stages() == [0, 1, 2, 3]
+
+
+def test_micro_batch_candidates_powers_of_two():
+    info = ModelInfo(num_params=1_000_000, activation_mem_per_mbs=1 * GiB)
+    at = Autotuner(info, runner=lambda e: None, dp_size=1, device_memory=10 * GiB)
+    # ~10 GiB free -> mbs up to 8 (powers of two <= ~9.98)
+    assert at.micro_batch_candidates(3) == [1, 2, 4, 8]
+
+
+def test_user_micro_batch_override():
+    info = ModelInfo(num_params=1_000_000, activation_mem_per_mbs=1 * GiB)
+    cfg = AutotuningConfig(micro_batch_sizes=[2, 6, 64])
+    at = Autotuner(info, runner=lambda e: None, dp_size=1,
+                   device_memory=10 * GiB, config=cfg)
+    assert at.micro_batch_candidates(3) == [2, 6]  # 64 exceeds the memory cap
+
+
+# ------------------------------------------------------------------- tuners
+def _space(n):
+    return [{"x": i} for i in range(n)]
+
+
+def test_grid_tuner_order_and_early_stop():
+    seen = []
+
+    def run(e):
+        seen.append(e["x"])
+        return -abs(e["x"] - 2)  # peak at x=2
+
+    t = GridSearchTuner(_space(20), run, early_stopping=3)
+    best, metric = t.tune()
+    assert seen[:3] == [0, 1, 2]
+    assert best == {"x": 2} and metric == 0
+    # stopped 3 non-improving trials after the peak
+    assert len(seen) == 6
+
+
+def test_random_tuner_finds_peak():
+    random.seed(0)
+    t = RandomTuner(_space(10), lambda e: -abs(e["x"] - 7), early_stopping=10)
+    best, _ = t.tune()
+    assert best == {"x": 7}
+
+
+def test_model_based_tuner_converges_fast():
+    random.seed(1)
+    np.random.seed(1)
+    trials = []
+
+    def run(e):
+        trials.append(e)
+        return float(-(e["x"] - 25) ** 2)
+
+    t = ModelBasedTuner(_space(50), run, early_stopping=8, num_random=4)
+    best, _ = t.tune(num_trials=25)
+    assert best is not None and abs(best["x"] - 25) <= 2
+    assert len(trials) < 50  # beat exhaustive search
+
+
+def test_failed_experiments_are_pruned():
+    def run(e):
+        if e["x"] % 2 == 0:
+            return None  # simulated OOM
+        return float(e["x"])
+
+    t = GridSearchTuner(_space(10), run, early_stopping=10)
+    best, metric = t.tune()
+    assert best == {"x": 9} and metric == 9.0
+
+
+# ---------------------------------------------------------------- end-to-end
+def _synthetic_runner(exp):
+    """Deterministic landscape: stage 2 with mbs 8 and cheap remat is best."""
+    stage = exp["zero_optimization"]["stage"]
+    mbs = exp["train_micro_batch_size_per_gpu"]
+    policy = exp.get("activation_checkpointing", {}).get("policy")
+    thr = mbs * 10 - abs(mbs - 8) * 5
+    thr += {0: 0, 1: 5, 2: 10, 3: 2}[stage]
+    thr += 3 if policy == "dots_with_no_batch_dims_saveable" else 0
+    return {"throughput": float(thr), "latency": 1.0 / max(thr, 1), "flops": 0.0}
+
+
+def test_autotuner_end_to_end(tmp_path):
+    info = ModelInfo(num_params=10_000_000, activation_mem_per_mbs=512 << 20)
+    cfg = AutotuningConfig(tuner_type="gridsearch", tuner_early_stopping=50,
+                           fast=False,  # full space: remat policy included
+                           exps_dir=str(tmp_path / "exps"),
+                           results_dir=str(tmp_path / "results"))
+    at = Autotuner(info, _synthetic_runner, user_config={"optimizer": {"type": "adamw"}},
+                   dp_size=4, device_memory=8 * GiB, config=cfg)
+    best = at.tune()
+    assert best is not None
+    assert best["zero_optimization"]["stage"] == 2
+    assert best["train_micro_batch_size_per_gpu"] == 8
+    assert best["activation_checkpointing"]["policy"] == "dots_with_no_batch_dims_saveable"
+    assert best["optimizer"]["type"] == "adamw"  # user config preserved
+    path = at.write_results()
+    saved = json.load(open(path))
+    assert saved == best
+    lines = open(str(tmp_path / "exps" / "experiments.jsonl")).read().splitlines()
+    assert len(lines) == len(at.records) > 0
+
+
+def test_fast_mode_sweeps_micro_batch_only():
+    info = ModelInfo(num_params=10_000_000, activation_mem_per_mbs=512 << 20)
+    cfg = AutotuningConfig(tuner_type="gridsearch", fast=True, zero_stages=[2])
+    at = Autotuner(info, _synthetic_runner, dp_size=4, device_memory=8 * GiB, config=cfg)
+    exps = at.experiments_for_stage(2)
+    assert len(exps) == len(at.micro_batch_candidates(2))
+    assert all("activation_checkpointing" not in e for e in exps)
+
+
+def test_batch_cap_includes_gas():
+    """max_train_batch_size bounds mbs * gas * dp, not just mbs * dp."""
+    info = ModelInfo(num_params=1_000_000, activation_mem_per_mbs=1 << 20)
+    cfg = AutotuningConfig(max_train_batch_size=32)
+    at = Autotuner(info, _synthetic_runner, dp_size=2,
+                   user_config={"gradient_accumulation_steps": 4},
+                   device_memory=64 * GiB, config=cfg)
+    # 32 // (4 * 2) = 4 -> mbs candidates 1, 2, 4
+    assert at.micro_batch_candidates(0) == [1, 2, 4]
+    # the floor applies too, also scaled by gas * dp
+    cfg = AutotuningConfig(max_train_batch_size=32, min_train_batch_size=16,
+                           micro_batch_sizes=[1, 2, 4, 8])
+    at = Autotuner(info, _synthetic_runner, dp_size=2,
+                   user_config={"gradient_accumulation_steps": 4},
+                   device_memory=64 * GiB, config=cfg)
+    assert at.micro_batch_candidates(0) == [2, 4]
+
+
+def test_autotuner_respects_user_stage_list():
+    info = ModelInfo(num_params=10_000_000, activation_mem_per_mbs=512 << 20)
+    cfg = AutotuningConfig(tuner_type="gridsearch", zero_stages=[1])
+    at = Autotuner(info, _synthetic_runner, dp_size=4, device_memory=8 * GiB, config=cfg)
+    best = at.tune()
+    assert best["zero_optimization"]["stage"] == 1
+    assert all(r["stage"] == 1 for r in at.records)
+
+
+def test_metric_latency_negated():
+    info = ModelInfo(num_params=1_000_000, activation_mem_per_mbs=1 * GiB)
+    cfg = AutotuningConfig(metric="latency", tuner_type="gridsearch",
+                           zero_stages=[3], micro_batch_sizes=[4, 8])
+    at = Autotuner(info, _synthetic_runner, dp_size=1, device_memory=10 * GiB, config=cfg)
+    best = at.tune()
+    # lowest latency == highest throughput point in the sampled space
+    assert best["train_micro_batch_size_per_gpu"] == 8
+
+
+def test_engine_runner_measures_real_steps():
+    """make_engine_runner drives the actual Engine on the CPU mesh."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.autotuning.autotuner import make_engine_runner
+
+    def loss_fn(params, batch, rng=None):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w": np.ones((4, 2), np.float32)}
+
+    def batch_fn(n):
+        return {"x": np.ones((n, 4), np.float32), "y": np.zeros((n, 2), np.float32)}
+
+    runner = make_engine_runner(loss_fn, params, example_batch_fn=batch_fn,
+                                warmup_steps=1, measure_steps=2)
+    metrics = runner({"train_micro_batch_size_per_gpu": 2,
+                      "zero_optimization": {"stage": 0},
+                      "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+    assert metrics is not None
+    assert metrics["throughput"] > 0 and metrics["latency"] > 0
