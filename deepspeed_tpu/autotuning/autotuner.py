@@ -139,7 +139,7 @@ class Autotuner:
             cap = min(cap, self.config.max_train_batch_size // scale)
         floor = -(-self.config.min_train_batch_size // scale)  # ceil div
         if self.config.micro_batch_sizes:
-            return [m for m in self.config.micro_batch_sizes if floor <= m <= max(1, cap)]
+            return [m for m in self.config.micro_batch_sizes if floor <= m <= cap]
         out, m = [], 1
         while m <= cap:
             if m >= floor:
@@ -202,9 +202,12 @@ class Autotuner:
             if best_exp is not None and best_metric > self.best_metric:
                 self.best_metric = best_metric
                 self.best_exp = best_exp
+        best_display = None
+        if self.best_exp is not None:
+            # latency is negated internally for max-comparison; report raw
+            best_display = -self.best_metric if self.config.metric == "latency" else self.best_metric
         logger.info(f"autotuning: {len(self.records)} experiments in "
-                    f"{time.time() - t0:.1f}s; best {self.config.metric} = "
-                    f"{self.best_metric if self.best_exp else None}")
+                    f"{time.time() - t0:.1f}s; best {self.config.metric} = {best_display}")
         return self.best_exp
 
     # ----------------------------------------------------------------- output

@@ -1,1 +1,6 @@
-from .module import PipelineModule, partition_layers, pipe_rules, restack_for_pipeline
+from .engine import PipelineEngine1F1B
+from .module import (LayerSpec, PipelineModule, TiedLayerSpec, build_layer_specs,
+                     partition_balanced, partition_layers, pipe_rules,
+                     restack_for_pipeline)
+from .schedule import (DataParallelSchedule, InferenceSchedule, PipeSchedule,
+                       TrainSchedule)

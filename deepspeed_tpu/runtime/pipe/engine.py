@@ -1,0 +1,170 @@
+"""1F1B pipeline interpreter engine.
+
+Analog of deepspeed/runtime/pipe/engine.py (PipelineEngine:55 —
+``_exec_schedule:1357`` walks the instruction stream through
+``_INSTRUCTION_MAP``, exec handlers :651-1204) re-based on functional JAX:
+
+* a "forward pass" is ``jax.vjp`` of the stage function — the returned
+  closure IS the activation stash (the reference's pipe buffer), and dropping
+  it after the backward IS buffer reuse;
+* send/recv are in-process mailbox moves (single-host multi-device: arrays
+  already live on the stage's devices; the reference's p2p tensor-meta
+  protocol, pipe/p2p.py:50, is unnecessary under one runtime);
+* tied-weight gradient reduction (reference pipe/module.py:423-447
+  ``allreduce_tied_weight_gradients``) is a pytree-sum over the stages that
+  used the tied params.
+
+The engine asserts the 1F1B memory bound — at most ``num_pipe_buffers()``
+live vjp closures per stage — which is the entire point of 1F1B over GPipe.
+The compiled circular pipeline (module.py) remains the fully-jitted path;
+this engine trades one-program compilation for schedule-exact memory
+behavior and per-stage program isolation.
+"""
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .schedule import (BackwardPass, ForwardPass, LoadMicroBatch, OptimizerStep,
+                       RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad, TrainSchedule)
+
+
+def _tree_add(a, b):
+    if a is None:
+        return b
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+class PipelineEngine1F1B:
+    """Executes TrainSchedule streams over per-stage functions.
+
+    stage_fns[s](stage_params, tied_params, x) -> x  (last stage returns the
+    model output fed to ``loss_fn(out, label) -> scalar``).  ``tied_params``
+    is one pytree visible to every stage (word-embedding tying etc.); stages
+    that ignore it get zero contribution to its gradient.
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable], loss_fn: Callable,
+                 grad_reduce_fn: Optional[Callable] = None,
+                 optimizer_step_fn: Optional[Callable] = None):
+        self.stage_fns = list(stage_fns)
+        self.num_stages = len(self.stage_fns)
+        self.loss_fn = loss_fn
+        self.grad_reduce_fn = grad_reduce_fn
+        self.optimizer_step_fn = optimizer_step_fn
+        self.max_live_buffers = [0] * self.num_stages  # observability + tests
+
+    def train_batch(self, stage_params: Sequence[Any], micro_batches: Sequence[Any],
+                    labels: Sequence[Any], tied_params: Any = None):
+        """Run one 1F1B batch.  Returns (mean_loss, stage_grads, tied_grads).
+
+        ``micro_batches``/``labels``: length-M sequences; loss is averaged
+        over micro-batches (gradient-accumulation semantics, reference
+        engine.py train_batch:321)."""
+        S, M = self.num_stages, len(micro_batches)
+        if len(stage_params) != S:
+            raise ValueError(f"expected {S} stage param trees, got {len(stage_params)}")
+        if len(labels) != M:
+            raise ValueError("labels must match micro_batches in length")
+        tied = tied_params if tied_params is not None else {}
+        scheds = [TrainSchedule(M, S, s) for s in range(S)]
+        streams = [list(sch.steps()) for sch in scheds]
+        nbufs = [sch.num_pipe_buffers() for sch in scheds]
+
+        # per-stage mutable state, keyed by buffer slot
+        act_in = [dict() for _ in range(S)]      # received/loaded inputs
+        act_out = [dict() for _ in range(S)]     # produced outputs (to send)
+        vjps = [dict() for _ in range(S)]        # live closures = 1F1B memory
+        loss_vjps = [dict() for _ in range(S)]
+        grad_in = [dict() for _ in range(S)]
+        dx_out = [dict() for _ in range(S)]
+        # Cross-stage mailboxes are FIFO: buffer ids are stage-local slots
+        # (num_pipe_buffers differs per stage), and micro-batches traverse
+        # each edge in order, so ordered hand-off is the pairing rule (the
+        # reference pairs by p2p rendezvous, pipe/p2p.py:50, same effect).
+        from collections import deque
+        act_mail = [deque() for _ in range(S)]   # from stage s-1
+        grad_mail = [deque() for _ in range(S)]  # from stage s+1
+        fwd_count = [0] * S
+        bwd_count = [0] * S
+        self.max_live_buffers = [0] * S
+
+        stage_grads: List[Any] = [None] * S
+        tied_grads: Any = None
+        total_loss = jnp.zeros(())
+        inv_m = 1.0 / M
+
+        total_ticks = 2 * (M + S - 1)
+        for tick in range(total_ticks):
+            for s in range(S):
+                for cmd in streams[s][tick]:
+                    buf = cmd.buffer_id
+                    if isinstance(cmd, LoadMicroBatch):
+                        if s == 0:
+                            act_in[0][buf] = micro_batches[fwd_count[0]]
+                    elif isinstance(cmd, RecvActivation):
+                        act_in[s][buf] = act_mail[s].popleft()
+                    elif isinstance(cmd, ForwardPass):
+                        m = fwd_count[s]
+                        x = act_in[s].pop(buf)
+                        out, vjp = jax.vjp(self.stage_fns[s], stage_params[s], tied, x)
+                        vjps[s][buf] = vjp
+                        self.max_live_buffers[s] = max(self.max_live_buffers[s], len(vjps[s]))
+                        assert len(vjps[s]) <= nbufs[s], (
+                            f"1F1B memory bound violated on stage {s}: "
+                            f"{len(vjps[s])} live buffers > {nbufs[s]}")
+                        if s == S - 1:
+                            loss, lvjp = jax.vjp(self.loss_fn, out, labels[m])
+                            total_loss = total_loss + loss * inv_m
+                            loss_vjps[s][buf] = lvjp
+                        else:
+                            act_out[s][buf] = out
+                        fwd_count[s] += 1
+                    elif isinstance(cmd, SendActivation):
+                        act_mail[s + 1].append(act_out[s].pop(buf))
+                    elif isinstance(cmd, RecvGrad):
+                        grad_in[s][buf] = grad_mail[s].popleft()
+                    elif isinstance(cmd, BackwardPass):
+                        if s == S - 1:
+                            dout, _dlabel = loss_vjps[s].pop(buf)(jnp.asarray(inv_m))
+                        else:
+                            dout = grad_in[s].pop(buf)
+                        dparams, dtied, dx = vjps[s].pop(buf)(dout)
+                        stage_grads[s] = _tree_add(stage_grads[s], dparams)
+                        tied_grads = _tree_add(tied_grads, dtied) if tied_params is not None else None
+                        if s > 0:
+                            dx_out[s][buf] = dx
+                        bwd_count[s] += 1
+                    elif isinstance(cmd, SendGrad):
+                        grad_mail[s - 1].append(dx_out[s].pop(buf))
+                    elif isinstance(cmd, ReduceTiedGrads):
+                        pass  # in-process: tied_grads already summed across stages
+                    elif isinstance(cmd, ReduceGrads):
+                        # every stage's stream carries the epilogue (one process
+                        # per rank in the reference); in-process, run it once
+                        if s == 0 and self.grad_reduce_fn is not None:
+                            stage_grads = [self.grad_reduce_fn(g) for g in stage_grads]
+                            if tied_grads is not None:
+                                tied_grads = self.grad_reduce_fn(tied_grads)
+                    elif isinstance(cmd, OptimizerStep):
+                        if s == 0 and self.optimizer_step_fn is not None:
+                            self.optimizer_step_fn(stage_grads, tied_grads)
+
+        assert all(c == M for c in fwd_count) and all(c == M for c in bwd_count), \
+            "schedule did not complete all forward/backward passes"
+        return total_loss, stage_grads, tied_grads
+
+    def eval_batch(self, stage_params: Sequence[Any], micro_batches: Sequence[Any],
+                   tied_params: Any = None):
+        """Forward-only fill-and-drain (reference eval_batch:405): returns the
+        last stage's outputs per micro-batch."""
+        tied = tied_params if tied_params is not None else {}
+        outs = []
+        for mb in micro_batches:
+            x = mb
+            for s in range(self.num_stages):
+                x = self.stage_fns[s](stage_params[s], tied, x)
+            outs.append(x)
+        return outs

@@ -38,6 +38,98 @@ def partition_layers(num_layers: int, num_stages: int):
     return num_layers // num_stages
 
 
+def partition_balanced(weights: Sequence[float], num_stages: int):
+    """Weight-balanced contiguous split (reference ``partition_method=
+    'parameters'``, pipe/module.py:385 via ds_utils.partition_balanced):
+    returns stage boundaries [b_0=0, ..., b_S=len] minimizing the heaviest
+    stage.  Binary-search over the bottleneck + greedy packing.
+
+    The compiled pipeline needs homogeneous stacks, so this feeds LayerSpec
+    grouping / cost modeling rather than the scan layout; the 1F1B engine
+    (engine.py) accepts arbitrary per-stage functions built from it."""
+    w = [float(x) for x in weights]
+    n = len(w)
+    if num_stages <= 0 or n < num_stages:
+        raise ValueError(f"cannot split {n} layers into {num_stages} stages")
+
+    def fits(cap):
+        parts, acc = 1, 0.0
+        for x in w:
+            if x > cap:
+                return False
+            if acc + x > cap:
+                parts += 1
+                acc = x
+            else:
+                acc += x
+        return parts <= num_stages
+
+    lo, hi = max(w), sum(w)
+    for _ in range(64):
+        mid = (lo + hi) / 2
+        if fits(mid):
+            hi = mid
+        else:
+            lo = mid
+    cap = hi
+    bounds, acc = [0], 0.0
+    for i, x in enumerate(w):
+        opened = len(bounds)              # parts started so far
+        still_to_open = num_stages - opened
+        nonempty = i > bounds[-1]
+        # break when over budget, or when every remaining layer must start a
+        # new part to keep all stages nonempty
+        if nonempty and still_to_open > 0 and (acc + x > cap or n - i == still_to_open):
+            bounds.append(i)
+            acc = x
+        else:
+            acc += x
+    bounds.append(n)
+    assert len(bounds) == num_stages + 1
+    return bounds
+
+
+class LayerSpec:
+    """Deferred layer description (reference pipe/module.py:30 LayerSpec):
+    bundles an init function + static kwargs so stage construction can happen
+    after placement is known.  ``build(key)`` returns the layer's params."""
+
+    def __init__(self, init_fn: Callable, **kwargs):
+        self.init_fn = init_fn
+        self.kwargs = kwargs
+
+    def build(self, key):
+        return self.init_fn(key, **self.kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec sharing parameters across stages by name (reference
+    pipe/module.py:77): all specs with one ``key_name`` resolve to a single
+    params tree, materialized once and passed as the pipeline's tied params
+    (gradient summing across stages is handled by the engine/shard_map
+    transpose — the analog of allreduce_tied_weight_gradients, :423-447)."""
+
+    def __init__(self, key_name: str, init_fn: Callable, **kwargs):
+        super().__init__(init_fn, **kwargs)
+        self.key_name = key_name
+
+
+def build_layer_specs(specs: Sequence[LayerSpec], key):
+    """Materialize params for a LayerSpec list: returns (per-layer params,
+    tied params dict).  Tied specs materialize once per key_name."""
+    tied = {}
+    layers = []
+    keys = jax.random.split(key, len(specs))
+    for spec, k in zip(specs, keys):
+        if isinstance(spec, TiedLayerSpec):
+            if spec.key_name not in tied:
+                tied[spec.key_name] = spec.build(k)
+            layers.append(("tied", spec.key_name))
+        else:
+            layers.append(("own", spec.build(k)))
+    return layers, tied
+
+
 def restack_for_pipeline(layer_params, num_stages: int):
     """[L, ...] stacked leaves -> [S, L/S, ...] for 'pipe' dim-0 sharding."""
 

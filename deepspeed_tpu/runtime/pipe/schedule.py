@@ -1,0 +1,191 @@
+"""Pipeline schedules: instruction streams for the 1F1B interpreter engine.
+
+Analog of deepspeed/runtime/pipe/schedule.py (PipeSchedule:11,
+TrainSchedule:189 — synchronous 1F1B, InferenceSchedule:135,
+DataParallelSchedule:301, instruction classes :327-489).
+
+The tick algebra here is a closed form rather than the reference's
+even/odd-parity case analysis: in synchronous 1F1B over S stages and M
+micro-batches,
+
+    forward  of micro-batch m on stage s runs at tick 2m + s
+    backward of micro-batch m on stage s runs at tick 2m + 2S - 1 - s
+
+which yields the same streams (last stage alternates F,B back-to-back; stage
+s keeps at most S - s forwards in flight awaiting their backward).  Total
+ticks = 2(M + S - 1).
+
+The compiled pipeline (module.py) does not interpret these — XLA schedules
+the scan — but the 1F1B engine (engine.py PipelineEngine1F1B) executes them
+eagerly with bounded live activations, and tests assert the memory bound.
+"""
+
+from dataclasses import dataclass
+from typing import Iterator, List
+
+
+# ------------------------------------------------------------- instructions
+@dataclass(frozen=True)
+class PipeInstruction:
+    """Base instruction (reference schedule.py:327).  ``buffer_id`` names the
+    activation/grad slot; buffers are recycled modulo num_pipe_buffers."""
+    buffer_id: int = 0
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+# ---------------------------------------------------------------- schedules
+class PipeSchedule:
+    """Generates this stage's per-tick command lists (reference :11)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    # convenience
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_mb(self, m: int) -> bool:
+        return 0 <= m < self.micro_batches
+
+    def num_pipe_buffers(self) -> int:
+        raise NotImplementedError
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+
+class TrainSchedule(PipeSchedule):
+    """Synchronous 1F1B (reference TrainSchedule:189)."""
+
+    def num_pipe_buffers(self) -> int:
+        """Max in-flight forwards on this stage = its distance from the end
+        (reference :254): earlier stages hold more awaiting backwards."""
+        return max(2, min(self.stages - self.stage_id, self.micro_batches))
+
+    def _fwd_mb(self, tick: int):
+        m, rem = divmod(tick - self.stage_id, 2)
+        return m if rem == 0 else None
+
+    def _bwd_mb(self, tick: int):
+        m, rem = divmod(tick - (2 * self.stages - 1 - self.stage_id), 2)
+        return m if rem == 0 else None
+
+    def steps(self):
+        s, S, M = self.stage_id, self.stages, self.micro_batches
+        nbuf = self.num_pipe_buffers()
+        total = 2 * (M + S - 1)
+        for tick in range(total):
+            cmds: List[PipeInstruction] = []
+            fm = self._fwd_mb(tick)
+            bm = self._bwd_mb(tick)
+            fwd_ok = fm is not None and self._valid_mb(fm)
+            bwd_ok = bm is not None and self._valid_mb(bm)
+
+            if fwd_ok:
+                buf = fm % nbuf
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            if bwd_ok:
+                buf = bm % nbuf
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buf))
+                cmds.append(BackwardPass(buf))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buf))
+
+            if tick == total - 1:
+                cmds.extend([ReduceTiedGrads(), ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-and-drain (reference InferenceSchedule:135)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        s, S, M = self.stage_id, self.stages, self.micro_batches
+        for tick in range(M + S - 1):
+            cmds: List[PipeInstruction] = []
+            m = tick - s
+            if self._valid_mb(m):
+                buf = m % 2
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference DataParallelSchedule:301)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for m in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if m == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

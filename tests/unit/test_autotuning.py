@@ -209,3 +209,15 @@ def test_engine_runner_measures_real_steps():
                       "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
     assert metrics is not None
     assert metrics["throughput"] > 0 and metrics["latency"] > 0
+
+
+def test_explicit_micro_batches_respect_zero_cap():
+    """cap==0 (batch window or memory excludes everything) must yield no
+    candidates even when the user lists explicit sizes."""
+    info = ModelInfo(num_params=1_000_000, activation_mem_per_mbs=1 << 20)
+    cfg = AutotuningConfig(max_train_batch_size=8, micro_batch_sizes=[1, 2])
+    at = Autotuner(info, _synthetic_runner, dp_size=4,
+                   user_config={"gradient_accumulation_steps": 4},
+                   device_memory=64 * GiB, config=cfg)
+    # scale = 16 > max_train_batch_size=8 -> cap 0 -> nothing fits
+    assert at.micro_batch_candidates(0) == []

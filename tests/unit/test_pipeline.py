@@ -143,3 +143,254 @@ def test_pipeline_training_with_engine():
         m = engine.train_batch({"x": x, "y": y})
         losses.append(float(m.loss))
     assert losses[-1] < losses[0], losses
+
+
+# ------------------------------------------------------------- 1F1B schedule
+def test_train_schedule_completes_all_passes():
+    from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                     TrainSchedule)
+    M, S = 6, 4
+    for s in range(S):
+        cmds = [c for tick in TrainSchedule(M, S, s).steps() for c in tick]
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == M
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == M
+
+
+def test_train_schedule_is_1f1b():
+    """In-flight forwards (fwd issued minus bwd retired) never exceed the
+    stage's pipe-buffer count — the 1F1B property that GPipe lacks."""
+    from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                     TrainSchedule)
+    M, S = 8, 4
+    for s in range(S):
+        sched = TrainSchedule(M, S, s)
+        live = peak = 0
+        for tick in sched.steps():
+            for c in tick:
+                if isinstance(c, ForwardPass):
+                    live += 1
+                elif isinstance(c, BackwardPass):
+                    live -= 1
+                peak = max(peak, live)
+        assert peak <= sched.num_pipe_buffers()
+        # last stage alternates F,B strictly: at most 1 in flight (buffers >= 2)
+        if s == S - 1:
+            assert peak == 1
+
+
+def test_train_schedule_send_recv_pairing():
+    """Every RecvActivation has a SendActivation one tick earlier upstream;
+    every RecvGrad a SendGrad one tick earlier downstream."""
+    from deepspeed_tpu.runtime.pipe.schedule import (RecvActivation, RecvGrad,
+                                                     SendActivation, SendGrad,
+                                                     TrainSchedule)
+    M, S = 5, 3
+    streams = [list(TrainSchedule(M, S, s).steps()) for s in range(S)]
+    for s in range(S):
+        for t, cmds in enumerate(streams[s]):
+            for c in cmds:
+                if isinstance(c, RecvActivation):
+                    assert any(isinstance(p, SendActivation)
+                               for p in streams[s - 1][t - 1])
+                if isinstance(c, RecvGrad):
+                    assert any(isinstance(p, SendGrad)
+                               for p in streams[s + 1][t - 1])
+
+
+def test_inference_schedule_forward_only():
+    from deepspeed_tpu.runtime.pipe.schedule import (BackwardPass, ForwardPass,
+                                                     InferenceSchedule)
+    cmds = [c for tick in InferenceSchedule(4, 2, 0).steps() for c in tick]
+    assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
+    assert not any(isinstance(c, BackwardPass) for c in cmds)
+
+
+# ------------------------------------------------------- balanced partitioning
+def test_partition_balanced_uniform():
+    from deepspeed_tpu.runtime.pipe.module import partition_balanced
+    assert partition_balanced([1.0] * 8, 4) == [0, 2, 4, 6, 8]
+
+
+def test_partition_balanced_skewed():
+    from deepspeed_tpu.runtime.pipe.module import partition_balanced
+    # one huge layer: it gets its own stage, the rest spread out
+    w = [10.0, 1, 1, 1, 1, 1]
+    bounds = partition_balanced(w, 3)
+    assert bounds[0] == 0 and bounds[-1] == 6
+    loads = [sum(w[bounds[i]:bounds[i + 1]]) for i in range(3)]
+    assert max(loads) == 10.0  # optimal bottleneck
+    # every stage nonempty
+    assert all(bounds[i + 1] > bounds[i] for i in range(3))
+
+
+def test_partition_balanced_exact_stages():
+    from deepspeed_tpu.runtime.pipe.module import partition_balanced
+    bounds = partition_balanced([1.0, 1.0, 1.0], 3)
+    assert bounds == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        partition_balanced([1.0, 1.0], 3)
+
+
+# --------------------------------------------------------------- layer specs
+def test_layer_specs_tied_materialize_once():
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec, build_layer_specs
+
+    def init(key, dim):
+        return {"w": jax.random.normal(key, (dim, dim))}
+
+    specs = [TiedLayerSpec("embed", init, dim=4), LayerSpec(init, dim=4),
+             TiedLayerSpec("embed", init, dim=4)]
+    layers, tied = build_layer_specs(specs, jax.random.PRNGKey(0))
+    assert set(tied) == {"embed"}
+    assert layers[0] == ("tied", "embed") and layers[2] == ("tied", "embed")
+    assert layers[1][0] == "own"
+
+
+# ------------------------------------------------------------ 1F1B engine
+def _mk_stage_fns(S):
+    def stage_fn(p, tied, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+    return [stage_fn] * S
+
+
+def _mk_stage_params(key, S, hidden=HIDDEN):
+    ks = jax.random.split(key, S)
+    return [{"w": jax.random.normal(k, (hidden, hidden)) * 0.5,
+             "b": jnp.zeros((hidden,))} for k in ks]
+
+
+def test_1f1b_engine_matches_direct_grad():
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine1F1B
+    S, M, mb = 3, 5, 4
+    params = _mk_stage_params(jax.random.PRNGKey(1), S)
+    rng = np.random.default_rng(2)
+    mbs = [jnp.asarray(rng.normal(size=(mb, HIDDEN)).astype(np.float32)) for _ in range(M)]
+    labels = [jnp.asarray(rng.normal(size=(mb, HIDDEN)).astype(np.float32)) for _ in range(M)]
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    eng = PipelineEngine1F1B(_mk_stage_fns(S), loss_fn)
+    loss, grads, tied_grads = eng.train_batch(params, mbs, labels)
+
+    def direct(params):
+        total = 0.0
+        for x, y in zip(mbs, labels):
+            for p in params:
+                x = jnp.tanh(x @ p["w"] + p["b"])
+            total = total + loss_fn(x, y)
+        return total / M
+
+    ref_loss = direct(params)
+    ref_grads = jax.grad(direct)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(grads, ref_grads):
+        for k in g:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(rg[k]),
+                                       rtol=1e-4, atol=1e-5)
+    assert tied_grads is None
+    # the 1F1B bound actually bit: stage 0 held <= S buffers, last stage 1
+    assert eng.max_live_buffers[0] <= S
+    assert eng.max_live_buffers[-1] == 1
+
+
+def test_1f1b_engine_tied_weight_grads():
+    """Tied embedding used by first and last stage: gradient is the sum of
+    both uses (reference allreduce_tied_weight_gradients, pipe/module.py:423)."""
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine1F1B
+    S, M, mb, H = 2, 4, 2, HIDDEN
+    rng = np.random.default_rng(3)
+    tied = {"embed": jnp.asarray(rng.normal(size=(H, H)).astype(np.float32) * 0.3)}
+    params = _mk_stage_params(jax.random.PRNGKey(4), S)
+
+    def stage0(p, t, x):
+        return jnp.tanh((x @ t["embed"]) @ p["w"] + p["b"])
+
+    def stage1(p, t, x):
+        h = jnp.tanh(x @ p["w"] + p["b"])
+        return h @ t["embed"].T  # tied unembed
+
+    mbs = [jnp.asarray(rng.normal(size=(mb, H)).astype(np.float32)) for _ in range(M)]
+    labels = [jnp.asarray(rng.normal(size=(mb, H)).astype(np.float32)) for _ in range(M)]
+
+    def loss_fn(out, label):
+        return jnp.mean((out - label) ** 2)
+
+    eng = PipelineEngine1F1B([stage0, stage1], loss_fn)
+    loss, grads, tied_grads = eng.train_batch(params, mbs, labels, tied_params=tied)
+
+    def direct(params, tied):
+        total = 0.0
+        for x, y in zip(mbs, labels):
+            h = stage0(params[0], tied, x)
+            out = stage1(params[1], tied, h)
+            total = total + loss_fn(out, y)
+        return total / M
+
+    ref_tied = jax.grad(direct, argnums=1)(params, tied)
+    np.testing.assert_allclose(np.asarray(tied_grads["embed"]),
+                               np.asarray(ref_tied["embed"]), rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_eval_batch():
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine1F1B
+    S = 2
+    params = _mk_stage_params(jax.random.PRNGKey(5), S)
+    eng = PipelineEngine1F1B(_mk_stage_fns(S), lambda o, l: jnp.mean(o))
+    mbs = [jnp.ones((2, HIDDEN)) for _ in range(3)]
+    outs = eng.eval_batch(params, mbs)
+    assert len(outs) == 3 and outs[0].shape == (2, HIDDEN)
+
+
+def test_compiled_pipeline_tied_embedding_grads():
+    """Tied embed/unembed AROUND the compiled pipeline: one differentiable
+    program, so the tied gradient sums both uses with no explicit allreduce."""
+    topo = MeshTopology.from_axis_dict({"pipe": 4, "data": 2})
+    set_topology(topo)
+    params = _init_layers(jax.random.PRNGKey(6))
+    stacked = restack_for_pipeline(params, 4)
+    pipe = PipelineModule(_layer_fn, num_stages=4, topo=topo)
+    M, mb = 4, 2
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, HIDDEN, size=(M, mb)))
+    embed = jnp.asarray(rng.normal(size=(HIDDEN, HIDDEN)).astype(np.float32) * 0.3)
+
+    def loss(embed, stacked):
+        x = embed[tokens]                      # tied use 1
+        h = pipe(stacked, x)
+        logits = h @ embed.T                   # tied use 2
+        return jnp.mean(logits ** 2)
+
+    def loss_plain(embed, params):
+        x = embed[tokens]
+        h = jax.vmap(lambda v: _reference_forward(params, v))(x)
+        logits = h @ embed.T
+        return jnp.mean(logits ** 2)
+
+    g = jax.grad(loss)(embed, stacked)
+    g_ref = jax.grad(loss_plain)(embed, params)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_epilogue_hooks_run_once():
+    """grad_reduce_fn applies once per stage tree and optimizer_step_fn once
+    per batch — not once per stage stream."""
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine1F1B
+    S, M = 3, 4
+    params = _mk_stage_params(jax.random.PRNGKey(8), S)
+    mbs = [jnp.ones((2, HIDDEN)) for _ in range(M)]
+    labels = [jnp.zeros((2, HIDDEN)) for _ in range(M)]
+    calls = {"reduce": 0, "step": 0}
+
+    def reduce_fn(g):
+        calls["reduce"] += 1
+        return g
+
+    def step_fn(grads, tied):
+        calls["step"] += 1
+
+    eng = PipelineEngine1F1B(_mk_stage_fns(S), lambda o, l: jnp.mean((o - l) ** 2),
+                             grad_reduce_fn=reduce_fn, optimizer_step_fn=step_fn)
+    eng.train_batch(params, mbs, labels)
+    assert calls["reduce"] == S  # once per stage gradient tree
+    assert calls["step"] == 1
