@@ -17,11 +17,15 @@ from ..op_builder import AsyncIOBuilder
 
 
 class AsyncIOHandle:
-    """ctypes wrapper over the native aio library."""
+    """ctypes wrapper over the native aio library.
 
-    def __init__(self, num_threads: int = 4):
+    ``use_odirect`` routes bulk transfers through O_DIRECT (page-cache bypass,
+    the reference's libaio mode); filesystems that reject it (tmpfs) fall back
+    to buffered I/O inside the library, per file."""
+
+    def __init__(self, num_threads: int = 4, use_odirect: bool = False):
         self._lib = AsyncIOBuilder().load()
-        self._h = self._lib.dstpu_aio_open(num_threads)
+        self._h = self._lib.dstpu_aio_open_ex(num_threads, int(use_odirect))
 
     def pwrite(self, path: str, arr: np.ndarray) -> int:
         arr = np.ascontiguousarray(arr)
@@ -102,9 +106,9 @@ class PyAsyncIOHandle:
         self._pool.shutdown(wait=True)
 
 
-def build_aio_handle(num_threads: int = 4):
+def build_aio_handle(num_threads: int = 4, use_odirect: bool = False):
     try:
-        return AsyncIOHandle(num_threads)
+        return AsyncIOHandle(num_threads, use_odirect=use_odirect)
     except Exception as exc:  # no compiler / build failure
         logger.warning(f"native aio unavailable ({exc}); using Python thread-pool fallback")
         return PyAsyncIOHandle(num_threads)

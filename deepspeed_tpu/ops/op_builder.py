@@ -97,6 +97,8 @@ class AsyncIOBuilder(OpBuilder):
     def _bind(self, lib):
         lib.dstpu_aio_open.restype = ctypes.c_void_p
         lib.dstpu_aio_open.argtypes = [ctypes.c_int]
+        lib.dstpu_aio_open_ex.restype = ctypes.c_void_p
+        lib.dstpu_aio_open_ex.argtypes = [ctypes.c_int, ctypes.c_int]
         lib.dstpu_aio_close.argtypes = [ctypes.c_void_p]
         lib.dstpu_aio_pwrite.restype = ctypes.c_int
         lib.dstpu_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,

@@ -139,6 +139,12 @@ class Engine:
 
         off = config.zero_optimization.offload_optimizer
         self.offload_device = off.device if (off is not None and off.device != "none") else None
+        off_p = config.zero_optimization.offload_param
+        if off_p is not None and off_p.device == "nvme":
+            raise NotImplementedError(
+                "offload_param: nvme needs the layer structure the opaque loss_fn hides — "
+                "use runtime.swap_tensor.partitioned_param_swapper.SwappedLayerTrainer "
+                "(the ZeRO-Infinity layer-streaming path) for NVMe-resident parameters")
         abstract = any(isinstance(p, jax.ShapeDtypeStruct) for p in jax.tree_util.tree_leaves(params))
         if abstract and param_init_fn is None:
             raise ValueError("model_parameters is abstract (ShapeDtypeStruct leaves); "

@@ -1,2 +1,4 @@
 """Tensor offload/swap machinery (reference runtime/swap_tensor/)."""
 from .optimizer_swapper import OffloadedAdamState
+from .partitioned_param_swapper import (AsyncPartitionedParameterSwapper,
+                                        SwappedLayerTrainer)

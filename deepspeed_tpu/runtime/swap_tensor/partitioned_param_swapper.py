@@ -1,0 +1,271 @@
+"""ZeRO-3 parameter NVMe swap (the ZeRO-Infinity param path).
+
+Analog of the reference swap_tensor param machinery:
+``AsyncPartitionedParameterSwapper`` (partitioned_param_swapper.py:36 —
+per-param NVMe files, aligned pinned buffer pool, swap_in/swap_out with
+async handles), ``AsyncTensorSwapper`` (async_swapper.py:19), and the
+prefetch driven by the ZeRO-3 coordinator
+(partitioned_param_coordinator.py:514 ``__prefetch_nvme_param_partitions``).
+
+TPU-native shape: the engine's compiled ZeRO-3 path gathers per-layer params
+inside one XLA program, which requires all shards resident in HBM.  When even
+the shards don't fit (offload_param: nvme), the layer loop must leave the
+compiled program: ``SwappedLayerTrainer`` streams one layer at a time —
+NVMe -> host buffer (async, double-buffered) -> device -> compute -> drop —
+with the backward pass re-fetching layers in reverse (ZeRO-Infinity
+re-gathers params for backward rather than caching them).  Device memory is
+bounded by ONE layer's params + activations of the micro-batch, regardless
+of model depth.
+"""
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.aio import build_aio_handle
+from ...utils.logging import log_dist
+
+
+class AsyncPartitionedParameterSwapper:
+    """NVMe backing store for named param groups with a reusable host
+    buffer pool and async prefetch.
+
+    Protocol per key: ``swap_out(key, arrays)`` persists; ``swap_in_async(key)``
+    starts reads into pool buffers; ``wait_in(key)`` joins and returns the
+    arrays (buffers on loan); ``release(key)`` returns buffers to the pool.
+    ``buffer_count`` bounds host memory exactly like the reference's
+    aio buffer pool (swap_tensor/utils.py:37 MIN_AIO_BYTES pools).
+    """
+
+    def __init__(self, nvme_path: str, buffer_count: int = 4, aio_threads: int = 4,
+                 use_odirect: bool = False):
+        self.dir = os.path.join(nvme_path, "dstpu_param_swap")
+        os.makedirs(self.dir, exist_ok=True)
+        self.aio = build_aio_handle(aio_threads, use_odirect=use_odirect)
+        self.buffer_count = buffer_count
+        self._free: List[np.ndarray] = []
+        self._allocated = 0
+        self._buf_bytes = 0
+        self._manifest: Dict[str, List[tuple]] = {}   # key -> [(shape, dtype), ...]
+        self._inflight: Dict[str, List[tuple]] = {}   # key -> [(rid, buffer, shape, dtype)]
+        self._loaned: Dict[str, List[np.ndarray]] = {}
+
+    # ------------------------------------------------------------ buffers
+    # Accounting invariant: _allocated == loaned + in-flight + len(_free); it
+    # only passes buffer_count via the warned growth path, so host memory is
+    # bounded at ~buffer_count * max-leaf-bytes (the reference's pinned pool
+    # contract, swap_tensor/utils.py:37).
+    def _take_buffer(self, nbytes: int) -> np.ndarray:
+        self._buf_bytes = max(self._buf_bytes, nbytes)
+        for i in range(len(self._free) - 1, -1, -1):  # pool may hold mixed sizes
+            if self._free[i].nbytes >= nbytes:
+                return self._free.pop(i)
+        if self._allocated >= self.buffer_count and self._free:
+            # replace an undersized free buffer instead of growing the pool
+            self._free.sort(key=lambda b: b.nbytes)
+            self._free.pop(0)
+            self._allocated -= 1
+        if self._allocated >= self.buffer_count:
+            # working set exceeded the configured pool: grow with a warning
+            # rather than deadlocking the layer stream (reference asserts)
+            from ...utils.logging import logger
+            logger.warning(f"param swap pool grew beyond buffer_count={self.buffer_count}; "
+                           f"consider raising offload_param.buffer_count")
+        self._allocated += 1
+        return np.empty(self._buf_bytes, np.uint8)
+
+    # ------------------------------------------------------------ file ops
+    def _file(self, key: str, i: int) -> str:
+        return os.path.join(self.dir, f"{key.replace('/', '_')}.{i}.bin")
+
+    def swap_out(self, key: str, arrays: Sequence[np.ndarray], wait: bool = True):
+        """Persist a param group (async unless ``wait``)."""
+        rids = []
+        manifest = []
+        for i, a in enumerate(arrays):
+            a = np.asarray(a)
+            manifest.append((a.shape, a.dtype))
+            rids.append(self.aio.pwrite(self._file(key, i), a))
+        self._manifest[key] = manifest
+        if wait:
+            for r in rids:
+                self.aio.wait(r)
+        return rids
+
+    def swap_in_async(self, key: str):
+        """Begin reading a group into pool buffers (the prefetch step)."""
+        if key in self._inflight or key in self._loaned:
+            return  # already prefetched / resident
+        entries = []
+        for i, (shape, dtype) in enumerate(self._manifest[key]):
+            nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+            buf = self._take_buffer(nbytes)
+            view = buf[:nbytes].view(dtype).reshape(shape)
+            rid = self.aio.pread(self._file(key, i), view)
+            entries.append((rid, buf, view))
+        self._inflight[key] = entries
+
+    def wait_in(self, key: str) -> List[np.ndarray]:
+        """Join the prefetch (issuing it now if it wasn't) and loan the arrays."""
+        if key not in self._inflight and key not in self._loaned:
+            self.swap_in_async(key)
+        if key in self._inflight:
+            views = []
+            for rid, buf, view in self._inflight.pop(key):
+                self.aio.wait(rid)
+                views.append((buf, view))
+            self._loaned[key] = views
+        return [view for _, view in self._loaned[key]]
+
+    def release(self, key: str):
+        """Return a group's buffers to the pool (reference
+        remove_partition_and_release_buffers)."""
+        for buf, _view in self._loaned.pop(key, []):
+            self._free.append(buf)
+
+    def available_swap_in_buffers(self) -> int:
+        return len(self._free)
+
+
+class SwappedLayerTrainer:
+    """Layer-streamed training with NVMe-resident params (ZeRO-Infinity).
+
+    ``layer_fn(params_l, x) -> x`` over ``num_layers`` homogeneous layers whose
+    params live on NVMe; ``head_fn(head_params, x, batch) -> loss`` stays
+    resident (embeddings/head are the reference's persistent params —
+    persistence_threshold analog).  Forward streams layers 0..L-1 saving each
+    layer's INPUT on host; backward streams L-1..0 re-fetching params,
+    recomputing the layer forward under ``jax.vjp``, and stepping that layer's
+    AdamW immediately (fp32 master + moments also NVMe-resident via the
+    optimizer swapper pattern) so no full gradient tree ever materializes.
+    """
+
+    def __init__(self, layer_fn: Callable, num_layers: int, head_fn: Callable,
+                 swapper: AsyncPartitionedParameterSwapper,
+                 lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, compute_dtype=jnp.bfloat16):
+        self.layer_fn = layer_fn
+        self.num_layers = num_layers
+        self.head_fn = head_fn
+        self.swapper = swapper
+        self.compute_dtype = compute_dtype
+        from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
+        self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
+        self.step_count = 0
+        self._layer_treedef = None
+        self._fwd_jit = jax.jit(lambda p, x: self.layer_fn(p, x))
+        # backward recompute, compiled: (params, x, cotangent) -> (dparams, dx)
+        self._bwd_jit = jax.jit(lambda p, x, ct: jax.vjp(self.layer_fn, p, x)[1](ct))
+
+    # ---------------------------------------------------------- initialize
+    def init_from_stacked(self, stacked_params: Any, head_params: Any):
+        """Shard a [L, ...] stacked layer pytree onto NVMe (fp32 master +
+        zero moments per layer) and keep head params host-resident."""
+        leaves, self._layer_treedef = jax.tree_util.tree_flatten(stacked_params)
+        for l in range(self.num_layers):
+            layer = [np.asarray(leaf[l], np.float32) for leaf in leaves]
+            self.swapper.swap_out(self._pkey(l), layer, wait=False)
+            zeros = [np.zeros_like(a) for a in layer]
+            self.swapper.swap_out(self._mkey(l), zeros, wait=False)
+            self.swapper.swap_out(self._vkey(l), zeros, wait=False)
+        self.swapper.aio.wait_all()
+        self.head = jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), head_params)
+        n = sum(int(np.prod(np.shape(x))) for x in leaves)
+        log_dist(f"param nvme swap: {self.num_layers} layers, {n/1e6:.2f}M stacked elems "
+                 f"on {self.swapper.dir}", ranks=[0])
+
+    def _pkey(self, l):
+        return f"layer{l}.p"
+
+    def _mkey(self, l):
+        return f"layer{l}.m"
+
+    def _vkey(self, l):
+        return f"layer{l}.v"
+
+    def _device_params(self, host_leaves):
+        tree = jax.tree_util.tree_unflatten(self._layer_treedef, host_leaves)
+        return jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), tree)
+
+    # ---------------------------------------------------------- train step
+    def train_step(self, batch: Dict[str, np.ndarray], lr: Optional[float] = None):
+        """One full fwd+bwd+update with layer streaming.  Returns the loss."""
+        x = jnp.asarray(batch["x"], self.compute_dtype)
+        saved_inputs: List[np.ndarray] = [None] * self.num_layers
+
+        # ---- forward: stream 0..L-1, double-buffered prefetch
+        self.swapper.swap_in_async(self._pkey(0))
+        for l in range(self.num_layers):
+            if l + 1 < self.num_layers and self.swapper.available_swap_in_buffers() > 0:
+                self.swapper.swap_in_async(self._pkey(l + 1))
+            host = self.swapper.wait_in(self._pkey(l))
+            saved_inputs[l] = np.asarray(x)  # activation checkpoint on host
+            x = self._fwd_jit(self._device_params(host), x)
+            self.swapper.release(self._pkey(l))
+
+        # ---- head loss + gradient of head params and last activation
+        head_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.head)
+        (loss, dhead, dx) = self._head_grads(head_dev, x, batch)
+        self.step_count += 1
+        step = self.step_count
+        flat_head, head_def = jax.tree_util.tree_flatten(self.head)
+        flat_dhead = jax.tree_util.tree_leaves(dhead)
+        if not hasattr(self, "_head_m"):
+            self._head_m = [np.zeros_like(a) for a in flat_head]
+            self._head_v = [np.zeros_like(a) for a in flat_head]
+        for p, m, v, g in zip(flat_head, self._head_m, self._head_v, flat_dhead):
+            self.opt.step(p.ravel(), m.ravel(), v.ravel(),
+                          np.asarray(g, np.float32).ravel(), lr=lr, step=step)
+
+        # ---- backward: stream L-1..0, recompute layer fwd, step immediately
+        for l in reversed(range(self.num_layers)):
+            if l - 1 >= 0 and self.swapper.available_swap_in_buffers() > 0:
+                self.swapper.swap_in_async(self._pkey(l - 1))
+            host = self.swapper.wait_in(self._pkey(l))
+            params_dev = self._device_params(host)
+            x_in = jnp.asarray(saved_inputs[l], self.compute_dtype)
+            dparams, dx = self._bwd_jit(params_dev, x_in, dx.astype(self.compute_dtype))
+            # stream this layer's optimizer state in, step, write back
+            m_host = self.swapper.wait_in(self._mkey(l))
+            v_host = self.swapper.wait_in(self._vkey(l))
+            grads = [np.asarray(g, np.float32) for g in jax.tree_util.tree_leaves(dparams)]
+            for p, m, v, g in zip(host, m_host, v_host, grads):
+                self.opt.step(p.ravel(), m.ravel(), v.ravel(), g.ravel(), lr=lr, step=step)
+            # join THIS layer's writes (by rid — wait_all would orphan the
+            # in-flight prefetch of layer l-1) before its buffers recycle: a
+            # pooled buffer must not be overwritten mid-write, and the next
+            # step's forward re-reads these files
+            rids = []
+            rids += self.swapper.swap_out(self._pkey(l), host, wait=False)
+            rids += self.swapper.swap_out(self._mkey(l), m_host, wait=False)
+            rids += self.swapper.swap_out(self._vkey(l), v_host, wait=False)
+            for r in rids:
+                self.swapper.aio.wait(r)
+            self.swapper.release(self._pkey(l))
+            self.swapper.release(self._mkey(l))
+            self.swapper.release(self._vkey(l))
+        return float(loss)
+
+    def _head_grads(self, head_dev, x, batch):
+        labels = jnp.asarray(batch["y"])
+
+        def head_loss(h, xx):
+            return self.head_fn(h, xx, labels)
+
+        loss, grads = jax.value_and_grad(head_loss, argnums=(0, 1))(head_dev, x)
+        return loss, grads[0], grads[1]
+
+    # ---------------------------------------------------------- inference
+    def forward(self, x: np.ndarray):
+        x = jnp.asarray(x, self.compute_dtype)
+        self.swapper.swap_in_async(self._pkey(0))
+        for l in range(self.num_layers):
+            if l + 1 < self.num_layers and self.swapper.available_swap_in_buffers() > 0:
+                self.swapper.swap_in_async(self._pkey(l + 1))
+            host = self.swapper.wait_in(self._pkey(l))
+            x = self._fwd_jit(self._device_params(host), x)
+            self.swapper.release(self._pkey(l))
+        return x

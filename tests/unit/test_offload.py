@@ -71,3 +71,131 @@ def test_offload_eval_and_fp32_export(mesh8):
     assert np.isfinite(loss)
     fp32 = eng.get_fp32_params()
     assert fp32["layer_0"]["w"].shape == (64, 64)
+
+
+# ---------------------------------------------------- ZeRO-Infinity param swap
+def test_aio_odirect_roundtrip(tmp_path):
+    """O_DIRECT handle round-trips unaligned sizes (bulk via aligned staging,
+    tail buffered; tmpfs rejection falls back internally)."""
+    from deepspeed_tpu.ops.aio import build_aio_handle
+    h = build_aio_handle(2, use_odirect=True)
+    arr = np.arange(4096 * 2 // 4 + 25, dtype=np.float32)  # 2 blocks + 100B tail
+    path = str(tmp_path / "od.bin")
+    assert h.wait(h.pwrite(path, arr)) == arr.nbytes
+    out = np.empty_like(arr)
+    assert h.wait(h.pread(path, out)) == arr.nbytes
+    np.testing.assert_array_equal(arr, out)
+    small = np.arange(7, dtype=np.float32)  # pure sub-block tail
+    h.wait(h.pwrite(str(tmp_path / "s.bin"), small))
+    out2 = np.empty_like(small)
+    h.wait(h.pread(str(tmp_path / "s.bin"), out2))
+    np.testing.assert_array_equal(small, out2)
+    h.close()
+
+
+def test_param_swapper_protocol(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import AsyncPartitionedParameterSwapper
+    sw = AsyncPartitionedParameterSwapper(str(tmp_path), buffer_count=4)
+    a = np.arange(32, dtype=np.float32).reshape(4, 8)
+    b = np.ones((8,), np.float32)
+    sw.swap_out("g0", [a, b])
+    sw.swap_in_async("g0")
+    views = sw.wait_in("g0")
+    np.testing.assert_array_equal(views[0], a)
+    np.testing.assert_array_equal(views[1], b)
+    # mutate the loan, write back, re-read
+    views[0][...] = 7.0
+    sw.swap_out("g0", views)
+    sw.release("g0")
+    assert sw.available_swap_in_buffers() >= 2
+    again = sw.wait_in("g0")  # implicit swap_in
+    assert (np.asarray(again[0]) == 7.0).all()
+    sw.release("g0")
+
+
+def test_param_swapper_buffer_reuse(tmp_path):
+    """Buffers cycle through the pool across groups (bounded host memory)."""
+    from deepspeed_tpu.runtime.swap_tensor import AsyncPartitionedParameterSwapper
+    sw = AsyncPartitionedParameterSwapper(str(tmp_path), buffer_count=2)
+    for i in range(6):
+        sw.swap_out(f"g{i}", [np.full((16,), i, np.float32)])
+    for i in range(6):
+        v = sw.wait_in(f"g{i}")
+        assert (np.asarray(v[0]) == i).all()
+        sw.release(f"g{i}")
+    assert 1 <= sw.available_swap_in_buffers() <= 2  # pool stayed within bound
+    assert sw._allocated <= 2
+
+
+def test_swapped_layer_trainer_converges(tmp_path):
+    """ZeRO-Infinity slice: params + Adam moments NVMe-resident, one layer on
+    device at a time, loss decreases (reference 'done' criterion: stage-3 +
+    offload_param nvme trains a toy model with bounded device memory)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.swap_tensor import (AsyncPartitionedParameterSwapper,
+                                                   SwappedLayerTrainer)
+
+    L, H, B = 4, 16, 8
+
+    def layer_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def head_fn(h, x, y):
+        pred = x @ h["out"]
+        return jnp.mean((pred - y.astype(pred.dtype)) ** 2).astype(jnp.float32)
+
+    ks = jax.random.split(jax.random.PRNGKey(0), L)
+    stacked = {"w": jnp.stack([jax.random.normal(k, (H, H)) * 0.4 for k in ks]),
+               "b": jnp.zeros((L, H))}
+    head = {"out": np.asarray(jax.random.normal(jax.random.PRNGKey(9), (H, H)) * 0.2)}
+
+    sw = AsyncPartitionedParameterSwapper(str(tmp_path), buffer_count=8)
+    trainer = SwappedLayerTrainer(layer_fn, L, head_fn, sw, lr=3e-2,
+                                  compute_dtype=jnp.float32)
+    trainer.init_from_stacked(stacked, head)
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(H, H)).astype(np.float32) * 0.3
+    x = rng.normal(size=(B, H)).astype(np.float32)
+    y = np.tanh(x @ w_true)
+
+    losses = [trainer.train_step({"x": x, "y": y}) for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.7, losses
+    # forward-only path agrees with the trained weights
+    out = trainer.forward(x)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_param_swapper_pool_bounded_across_size_growth(tmp_path):
+    """Growing leaf sizes must not balloon the pool: undersized free buffers
+    are replaced, keeping total allocations at buffer_count."""
+    from deepspeed_tpu.runtime.swap_tensor import AsyncPartitionedParameterSwapper
+    sw = AsyncPartitionedParameterSwapper(str(tmp_path), buffer_count=2)
+    sw.swap_out("small", [np.zeros(16, np.float32)])
+    sw.wait_in("small")
+    sw.release("small")
+    sw.swap_out("big", [np.zeros(1 << 18, np.float32)])
+    sw.wait_in("big")
+    sw.release("big")
+    assert sw._allocated <= 2
+    # and a small request can still reuse a big free buffer
+    sw.wait_in("small")
+    sw.release("small")
+    assert sw._allocated <= 2
+
+
+def test_aio_odirect_zero_byte_semantics(tmp_path):
+    """Zero-byte writes create the file; zero-byte reads of a missing file
+    fail — identical to the buffered path."""
+    from deepspeed_tpu.ops.aio import build_aio_handle, AsyncIOHandle
+    h = build_aio_handle(1, use_odirect=True)
+    if not isinstance(h, AsyncIOHandle):
+        pytest.skip("native aio unavailable")
+    empty = np.empty(0, dtype=np.float32)
+    path = str(tmp_path / "zero.bin")
+    assert h.wait(h.pwrite(path, empty)) == 0
+    assert (tmp_path / "zero.bin").exists()
+    with pytest.raises(OSError):
+        h.wait(h.pread(str(tmp_path / "missing.bin"), empty))
+    h.close()
