@@ -132,7 +132,9 @@ struct Handle {
           ::close(fd);
           return n;
         }
-        if (!req.is_write) std::memcpy(user + off, stage, chunk);
+        // copy only the bytes actually read — a short read must not leak the
+        // staging buffer's previous contents past EOF
+        if (!req.is_write && n > 0) std::memcpy(user + off, stage, static_cast<size_t>(n));
         total += n;
         if (static_cast<size_t>(n) < chunk) break;  // EOF
       }

@@ -159,6 +159,10 @@ class SwappedLayerTrainer:
         self._fwd_jit = jax.jit(lambda p, x: self.layer_fn(p, x))
         # backward recompute, compiled: (params, x, cotangent) -> (dparams, dx)
         self._bwd_jit = jax.jit(lambda p, x, ct: jax.vjp(self.layer_fn, p, x)[1](ct))
+        # head loss+grads, compiled (labels as a traced argument)
+        self._head_jit = jax.jit(
+            lambda h, x, y: jax.value_and_grad(
+                lambda hh, xx: self.head_fn(hh, xx, y), argnums=(0, 1))(h, x))
 
     # ---------------------------------------------------------- initialize
     def init_from_stacked(self, stacked_params: Any, head_params: Any):
@@ -250,12 +254,7 @@ class SwappedLayerTrainer:
         return float(loss)
 
     def _head_grads(self, head_dev, x, batch):
-        labels = jnp.asarray(batch["y"])
-
-        def head_loss(h, xx):
-            return self.head_fn(h, xx, labels)
-
-        loss, grads = jax.value_and_grad(head_loss, argnums=(0, 1))(head_dev, x)
+        loss, grads = self._head_jit(head_dev, x, jnp.asarray(batch["y"]))
         return loss, grads[0], grads[1]
 
     # ---------------------------------------------------------- inference

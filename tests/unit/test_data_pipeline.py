@@ -1,0 +1,151 @@
+"""Indexed dataset + data analyzer tests (reference analog:
+tests/unit/runtime/test_data.py + data_analyzer usage in data_sampling)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (DataAnalyzer, MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 best_fitting_dtype, dataset_exists)
+
+
+def _build_corpus(prefix, samples, dtype=np.int32, docs_at=()):
+    b = MMapIndexedDatasetBuilder(str(prefix), dtype=dtype)
+    for i, s in enumerate(samples):
+        b.add_item(s)
+        if i in docs_at:
+            b.end_document()
+    b.end_document()
+    b.finalize()
+
+
+def test_roundtrip_and_zero_copy(tmp_path):
+    samples = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+    _build_corpus(tmp_path / "corpus", samples)
+    ds = MMapIndexedDataset(str(tmp_path / "corpus"))
+    assert len(ds) == 4
+    for got, want in zip(ds[:], samples):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.sizes, [3, 7, 1, 12])
+    assert ds.num_tokens(3) == 12
+    # windowed read
+    np.testing.assert_array_equal(ds.get(3, offset=2, length=4), [2, 3, 4, 5])
+    with pytest.raises(IndexError):
+        ds.get(0, offset=2, length=5)
+    with pytest.raises(IndexError):
+        ds[4]
+    assert dataset_exists(str(tmp_path / "corpus"))
+
+
+def test_format_header_fields(tmp_path):
+    """The idx header is byte-compatible MMIDIDX v1 (interop with corpora
+    produced by Megatron/DeepSpeed tooling)."""
+    _build_corpus(tmp_path / "c", [np.array([1, 2], np.uint16)], dtype=np.uint16)
+    raw = open(str(tmp_path / "c.idx"), "rb").read()
+    assert raw[:9] == b"MMIDIDX\x00\x00"
+    import struct
+    assert struct.unpack("<Q", raw[9:17])[0] == 1      # version
+    assert struct.unpack("<B", raw[17:18])[0] == 8     # uint16 code
+    assert struct.unpack("<Q", raw[18:26])[0] == 1     # num sequences
+
+
+def test_doc_idx_boundaries(tmp_path):
+    _build_corpus(tmp_path / "d", [np.ones(2, np.int32)] * 5, docs_at=(1, 3))
+    ds = MMapIndexedDataset(str(tmp_path / "d"))
+    np.testing.assert_array_equal(ds.doc_idx, [0, 2, 4, 5])
+
+
+def test_merge_file(tmp_path):
+    _build_corpus(tmp_path / "a", [np.array([1, 2], np.int32)])
+    _build_corpus(tmp_path / "b", [np.array([3], np.int32), np.array([4, 5, 6], np.int32)])
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "merged"), dtype=np.int32)
+    m.merge_file_(str(tmp_path / "a"))
+    m.merge_file_(str(tmp_path / "b"))
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "merged"))
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[0], [1, 2])
+    np.testing.assert_array_equal(ds[2], [4, 5, 6])
+
+
+def test_best_fitting_dtype():
+    assert best_fitting_dtype(30000) == np.uint16
+    assert best_fitting_dtype(100000) == np.int32
+    assert best_fitting_dtype(None) == np.int32
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    """Two-worker map + reduce: seqlen metric indexes every sample; sum
+    metric accumulates corpus-wide."""
+    samples = [np.arange(n, dtype=np.int32) for n in (5, 3, 5, 8, 3, 5)]
+    _build_corpus(tmp_path / "corpus", samples)
+    ds = MMapIndexedDataset(str(tmp_path / "corpus"))
+    save = str(tmp_path / "analysis")
+
+    def make(worker_id):
+        return DataAnalyzer(ds, ["seqlen", "total_tokens"],
+                            [len, len], ["single_value_per_sample",
+                                         "accumulate_value_over_samples"],
+                            save_path=save, num_workers=2, worker_id=worker_id)
+
+    make(0).run_map()
+    make(1).run_map()
+    make(0).run_reduce()
+
+    s2m = DataAnalyzer.load_sample_to_metric(save, "seqlen")
+    np.testing.assert_array_equal(s2m, [5, 3, 5, 8, 3, 5])
+    m2s = DataAnalyzer.load_metric_to_sample(save, "seqlen")
+    np.testing.assert_array_equal(m2s[5], [0, 2, 5])
+    np.testing.assert_array_equal(m2s[3], [1, 4])
+    import json
+    total = json.load(open(save + "/total_tokens_sum.json"))["sum"]
+    assert total == sum(len(s) for s in samples)
+    pct = DataAnalyzer.get_metric_percentiles(save, "seqlen", [50.0, 100.0])
+    assert pct[100.0] == 8.0
+
+
+def test_analyzer_feeds_curriculum(tmp_path):
+    """The analyzer's difficulty index drives a curriculum bucket selection —
+    the end-to-end data-efficiency flow."""
+    samples = [np.zeros(n, np.int32) for n in (2, 4, 6, 8)]
+    _build_corpus(tmp_path / "c", samples)
+    ds = MMapIndexedDataset(str(tmp_path / "c"))
+    save = str(tmp_path / "an")
+    an = DataAnalyzer(ds, ["seqlen"], [len], ["single_value_per_sample"], save_path=save)
+    an.run_map()
+    an.run_reduce()
+    m2s = DataAnalyzer.load_metric_to_sample(save, "seqlen")
+    # curriculum at difficulty <= 6: only samples with seqlen <= 6 eligible
+    eligible = sorted(i for v, idxs in m2s.items() if v <= 6 for i in idxs)
+    assert eligible == [0, 1, 2]
+
+
+def test_empty_dataset_and_idle_worker(tmp_path):
+    """A zero-sample dataset opens; an idle analyzer worker's empty shard
+    doesn't break the reduce."""
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "empty"), dtype=np.int32)
+    b.end_document()
+    b.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "empty"))
+    assert len(ds) == 0
+    # 3 samples over 4 workers: worker 3 gets an empty range
+    samples = [np.zeros(2, np.int32)] * 3
+    _build_corpus(tmp_path / "c3", samples)
+    full = MMapIndexedDataset(str(tmp_path / "c3"))
+    save = str(tmp_path / "an4")
+    for w in range(4):
+        DataAnalyzer(full, ["seqlen"], [len], ["single_value_per_sample"],
+                     save_path=save, num_workers=4, worker_id=w).run_map()
+    DataAnalyzer(full, ["seqlen"], [len], ["single_value_per_sample"],
+                 save_path=save, num_workers=4, worker_id=0).run_reduce()
+    np.testing.assert_array_equal(DataAnalyzer.load_sample_to_metric(save, "seqlen"),
+                                  [2, 2, 2])
+
+
+def test_float_metric_rejected(tmp_path):
+    _build_corpus(tmp_path / "f", [np.zeros(3, np.int32)])
+    ds = MMapIndexedDataset(str(tmp_path / "f"))
+    an = DataAnalyzer(ds, ["rarity"], [lambda s: 0.5], ["single_value_per_sample"],
+                      save_path=str(tmp_path / "anx"))
+    with pytest.raises(ValueError, match="non-integral"):
+        an.run_map()

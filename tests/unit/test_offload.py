@@ -199,3 +199,26 @@ def test_aio_odirect_zero_byte_semantics(tmp_path):
     with pytest.raises(OSError):
         h.wait(h.pread(str(tmp_path / "missing.bin"), empty))
     h.close()
+
+
+def test_aio_odirect_short_read_no_stale_bytes(tmp_path):
+    """Reading more than the file holds must not copy stale staging-buffer
+    bytes past EOF."""
+    from deepspeed_tpu.ops.aio import build_aio_handle, AsyncIOHandle
+    h = build_aio_handle(1, use_odirect=True)
+    if not isinstance(h, AsyncIOHandle):
+        pytest.skip("native aio unavailable")
+    # seed the worker's staging buffer with a big previous request
+    junk = np.full(8192 // 4, 77, np.int32)
+    h.wait(h.pwrite(str(tmp_path / "junk.bin"), junk))
+    warm = np.empty_like(junk)
+    h.wait(h.pread(str(tmp_path / "junk.bin"), warm))
+    # short file, long read
+    short = np.full(4096 // 4, 5, np.int32)
+    h.wait(h.pwrite(str(tmp_path / "short.bin"), short))
+    out = np.zeros(8192 // 4, np.int32)
+    n = h.wait(h.pread(str(tmp_path / "short.bin"), out))
+    assert n == short.nbytes
+    np.testing.assert_array_equal(out[:1024], 5)
+    np.testing.assert_array_equal(out[1024:], 0)  # untouched, not 77
+    h.close()
