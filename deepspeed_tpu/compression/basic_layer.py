@@ -1,0 +1,122 @@
+"""Compression primitives: structured pruning masks, activation quantization,
+layer reduction.
+
+Analog of the reference's basic_layer.py (deepspeed/compression/
+basic_layer.py — ``LinearLayer_Compress`` with sparse/row/head pruning +
+weight quantization, ``QuantAct``, ``Embedding_Compress``) and the
+layer-reduction path of compress.py.  The reference subclasses nn.Linear and
+mutates modules; here every method is a pure array transform over param
+leaves, composing with the pytree walk in compress.init_compression.
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------ structured masks
+def head_prune_mask(w: jnp.ndarray, num_heads: int, density: float,
+                    head_axis: str = "in") -> jnp.ndarray:
+    """Attention-head pruning mask (reference head pruning on the attention
+    output projection, basic_layer.py head_pruning_*).
+
+    ``w`` is a 2D projection; heads tile the ``in`` (dim 0, the wo case: rows
+    are head_dim-sized groups of the attention output) or ``out`` axis (dim 1,
+    the wq/wk/wv case).  Heads are ranked by L1 norm; the weakest are zeroed
+    whole, keeping ``density`` fraction.
+    """
+    if w.ndim != 2:
+        raise ValueError("head pruning applies to 2D projections")
+    axis = 0 if head_axis == "in" else 1
+    if w.shape[axis] % num_heads != 0:
+        raise ValueError(f"axis {axis} size {w.shape[axis]} not divisible by {num_heads} heads")
+    head_dim = w.shape[axis] // num_heads
+    if axis == 0:
+        per_head = jnp.sum(jnp.abs(w).reshape(num_heads, head_dim, w.shape[1]), axis=(1, 2))
+    else:
+        per_head = jnp.sum(jnp.abs(w).reshape(w.shape[0], num_heads, head_dim), axis=(0, 2))
+    k = max(1, int(round(num_heads * density)))
+    thresh = jnp.sort(per_head)[-k]
+    keep = (per_head >= thresh).astype(w.dtype)  # [H]
+    if axis == 0:
+        mask = jnp.repeat(keep, head_dim)[:, None]
+    else:
+        mask = jnp.repeat(keep, head_dim)[None, :]
+    return jnp.broadcast_to(mask, w.shape)
+
+
+def channel_prune_mask(w: jnp.ndarray, density: float) -> jnp.ndarray:
+    """Structured channel (dim-0 / input-feature) pruning by L1 norm —
+    the reference's conv channel pruning retargeted to the leading axis."""
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    k = max(1, int(round(norms.size * density)))
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep.reshape((-1,) + (1,) * (w.ndim - 1)), w.shape)
+
+
+# ------------------------------------------------------ activation quantization
+class QuantAct:
+    """Activation fake-quantizer (reference QuantAct, basic_layer.py:41).
+
+    ``dynamic`` computes the range per call; static mode tracks a running
+    max (momentum EMA) that freezes for inference — call ``freeze()`` after
+    calibration.  Usage: wrap activations, e.g. ``x = qact(x)`` inside the
+    model's forward.
+    """
+
+    def __init__(self, bits: int = 8, dynamic: bool = True, momentum: float = 0.95):
+        self.bits = bits
+        self.dynamic = dynamic
+        self.momentum = momentum
+        self.running_max: Optional[float] = None
+        self.frozen = False
+
+    def freeze(self):
+        self.frozen = True
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        qmax = 2.0 ** (self.bits - 1) - 1
+        if self.dynamic:
+            scale = jnp.maximum(jnp.abs(x).max(), 1e-8) / qmax
+        else:
+            if not self.frozen:
+                cur = float(jnp.abs(x).max())
+                self.running_max = (cur if self.running_max is None else
+                                    self.momentum * self.running_max +
+                                    (1 - self.momentum) * cur)
+            scale = max(self.running_max or 1e-8, 1e-8) / qmax
+        q = jnp.clip(jnp.round(x / scale), -qmax, qmax)
+        return (q * scale).astype(x.dtype)
+
+
+# ------------------------------------------------------------- layer reduction
+def layer_reduction(stacked_params: Any, keep_layers: Sequence[int]) -> Any:
+    """Depth reduction on scan-stacked layer params (reference compress.py
+    layer_reduction: student keeps ``keep_layers`` of the teacher's layers,
+    e.g. [0, 2, 4, ...] — the teacher-layer remap of TinyBERT-style KD)."""
+    idx = np.asarray(keep_layers, np.int32)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    lead = {np.shape(l)[0] if np.ndim(l) >= 1 else 0 for l in leaves}
+    if len(lead) != 1:
+        # heterogeneous leading dims mean this is NOT a pure layer stack —
+        # silently slicing would corrupt e.g. an embedding table; callers must
+        # point at the stacked subtree (redundancy_clean's module_name_prefix)
+        raise ValueError(
+            f"layer_reduction needs a homogeneous [L, ...] stack; got leading "
+            f"dims {sorted(lead)} — select the stacked subtree explicitly")
+    (num_layers,) = lead
+    if num_layers <= int(idx.max()):
+        raise ValueError(f"keep_layers index {int(idx.max())} out of range for "
+                         f"{num_layers} layers")
+    return jax.tree_util.tree_map(lambda leaf: jnp.take(leaf, idx, axis=0), stacked_params)
+
+
+# --------------------------------------------------------- physical shrinking
+def shrink_rows(w: jnp.ndarray, mask_row_keep: np.ndarray) -> jnp.ndarray:
+    """Materialize row pruning by slicing the kept rows out (reference
+    redundancy_clean:148 — after mask training, weights physically shrink)."""
+    keep = np.nonzero(np.asarray(mask_row_keep))[0]
+    return jnp.take(w, keep, axis=w.ndim - 1)

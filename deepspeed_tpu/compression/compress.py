@@ -81,12 +81,17 @@ def init_compression(params: Any, config: Dict, paths: Optional[Any] = None) -> 
     def key_of(path):
         return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
+    from .basic_layer import channel_prune_mask, head_prune_mask
+
     wq = config.get("weight_quantization", {}).get("different_groups", {})
     sp = config.get("sparse_pruning", {}).get("different_groups", {})
     rp = config.get("row_pruning", {}).get("different_groups", {})
+    hp = config.get("head_pruning", {}).get("different_groups", {})
+    cp = config.get("channel_pruning", {}).get("different_groups", {})
+    hp_shared = config.get("head_pruning", {}).get("shared_parameters", {})
 
     out = []
-    n_q = n_s = n_r = 0
+    n_q = n_s = n_r = n_h = n_c = 0
     for path, leaf in flat:
         key = key_of(path)
         new = leaf
@@ -109,13 +114,56 @@ def init_compression(params: Any, config: Dict, paths: Optional[Any] = None) -> 
                     new = new * row_prune_mask(new, density)
                     n_r += 1
                     break
+            for group in hp.values():
+                if _match(key, group.get("modules", [".*"])):
+                    gp = group.get("params", {})
+                    heads = int(gp.get("num_heads", hp_shared.get("num_heads", 0)))
+                    if heads <= 0:
+                        raise ValueError("head_pruning needs num_heads (group params "
+                                         "or shared_parameters)")
+                    density = float(gp.get("dense_ratio", 0.5))
+                    new = new * head_prune_mask(new, heads, density,
+                                                head_axis=gp.get("head_axis", "in"))
+                    n_h += 1
+                    break
+            for group in cp.values():
+                if _match(key, group.get("modules", [".*"])):
+                    density = float(group.get("params", {}).get("dense_ratio", 0.5))
+                    new = new * channel_prune_mask(new, density)
+                    n_c += 1
+                    break
         out.append(new)
-    log_dist(f"compression: quantized={n_q} sparse-pruned={n_s} row-pruned={n_r} leaves",
-             ranks=[0])
+    log_dist(f"compression: quantized={n_q} sparse={n_s} row={n_r} head={n_h} "
+             f"channel={n_c} leaves", ranks=[0])
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def redundancy_clean(params: Any, config: Dict) -> Any:
-    """Materialize pruning by zeroing masked weights permanently
-    (reference redundancy_clean:148 — layer-reduction/slimming analog)."""
-    return init_compression(params, config)
+    """Materialize compression permanently (reference redundancy_clean:148):
+    re-apply masks so zeros are baked in, then perform layer reduction if the
+    config requests it (``compression_training.layer_reduction`` — student
+    keeps a subset of teacher layers, physically dropping the rest)."""
+    params = init_compression(params, config)
+    lr_cfg = config.get("layer_reduction", {})
+    if lr_cfg.get("enabled"):
+        from .basic_layer import layer_reduction
+        keep = lr_cfg.get("keep_layers")
+        if keep is None:
+            num = int(lr_cfg["keep_number_layer"])
+            total = int(lr_cfg["teacher_layer"])
+            keep = np.linspace(0, total - 1, num).round().astype(int).tolist()
+        target = lr_cfg.get("module_name_prefix")
+        if target:
+            sub = params
+            for part in target.split("."):
+                sub = sub[part]
+            reduced = layer_reduction(sub, keep)
+            params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy tree
+            node = params
+            parts = target.split(".")
+            for part in parts[:-1]:
+                node = node[part]
+            node[parts[-1]] = reduced
+        else:
+            params = layer_reduction(params, keep)
+    return params

@@ -52,9 +52,23 @@ class InferenceEngine:
         from ..runtime.zero.sharding import build_sharding_plan
         self.plan = build_sharding_plan(_NoZero(), self.topology, tp_rules=rules)
 
-        if self.config.quant.enabled:
-            params = self._quantize_dequantize(params)
-        self.params = self._shard_params(params)
+        self._quantized = self.config.quant.enabled
+        if self._quantized:
+            # real WOQ: weights live PACKED (int8/int4 + scales) in device
+            # memory; the jitted forward dequantizes per layer on the fly
+            # (inference/quantization.py).  Packed leaves replicate — TP
+            # sharding of packed layouts composes later.
+            from .quantization import is_woq_leaf, quantize_tree
+            params = quantize_tree(params, bits=self.config.quant.bits,
+                                   group_size=self.config.quant.group_size)
+            # non-packed leaves (norms, biases) still serve in the configured
+            # dtype — otherwise fp32 norms silently promote the whole forward
+            params = jax.tree_util.tree_map(
+                lambda x: x if is_woq_leaf(x) else jnp.asarray(x, self.dtype),
+                params, is_leaf=is_woq_leaf)
+            self.params = jax.device_put(params)
+        else:
+            self.params = self._shard_params(params)
         self._prefill = None
         self._decode = None
         self._samplers = {}
@@ -67,35 +81,22 @@ class InferenceEngine:
         shardings = self.plan.param_shardings(cast)
         return jax.jit(lambda p: p, out_shardings=shardings)(cast)
 
-    def _quantize_dequantize(self, params):
-        """Weight-only fake quantization (reference inference/quantization WOQ):
-        int8/int4 block-quantize then dequantize — serving-memory layout is a
-        follow-up; numerics match the quantized checkpoint."""
-        from ..ops.quantizer import (dequantize_int4, dequantize_int8, quantize_int4, quantize_int8)
-        bits = self.config.quant.bits
-        gs = self.config.quant.group_size
-
-        def q(x):
-            if x.ndim < 2 or x.size < gs:
-                return x
-            if bits == 8:
-                qq, ss, n = quantize_int8(x, gs)
-                return dequantize_int8(qq, ss, n, shape=x.shape, dtype=x.dtype)
-            qq, ss, n = quantize_int4(x, gs)
-            return dequantize_int4(qq, ss, n, shape=x.shape, dtype=x.dtype)
-
-        return jax.tree_util.tree_map(q, params)
-
     # ------------------------------------------------------------ compiled fns
     def _build(self, batch: int, max_seq: int):
         model, cfg = self.model, self.model_config
         attn = self.attention_fn
+        if self._quantized:
+            from .quantization import dequantize_tree
+            dtype = self.dtype
+            unpack = lambda p: dequantize_tree(p, dtype)  # inside jit: fused
+        else:
+            unpack = lambda p: p
 
         def prefill(params, ids, cache):
-            return model.forward_with_cache(cfg, params, ids, cache, attention_fn=attn)
+            return model.forward_with_cache(cfg, unpack(params), ids, cache, attention_fn=attn)
 
         def decode(params, last, cache):
-            return model.forward_with_cache(cfg, params, last, cache, attention_fn=attn)
+            return model.forward_with_cache(cfg, unpack(params), last, cache, attention_fn=attn)
 
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)

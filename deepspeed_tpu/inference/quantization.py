@@ -1,0 +1,123 @@
+"""Weight-only quantization (WOQ) for inference serving.
+
+Analog of deepspeed/inference/quantization/ (quantization.py
+``_init_group_wise_weight_quantization``, layers.py QuantizedLinear — int8/int4
+weight-only layers dequantizing on the fly, 530 LoC): matched 2D weights are
+stored PACKED (int8, or int4 two-per-byte) with per-group scales — a 4x/8x
+HBM reduction over fp32 at rest — and dequantized to the compute dtype inside
+the jitted forward.  Under the models' scan-over-layers at most one layer's
+dequantized weights are live at a time, so peak HBM follows the packed size,
+not the dense size (the TPU equivalent of the reference's fused
+dequant+gemm CUDA path).
+
+The packed leaf is a registered pytree node (``WOQLeaf``): the int tensors
+``q``/``s`` are its children (traced, device-resident) while bits/shape are
+static aux data, so the whole tree flows through jit/device_put unchanged and
+``dequantize_tree`` restores a dense tree INSIDE the compiled program.
+"""
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quantizer import (dequantize_int4, dequantize_int8, quantize_int4,
+                             quantize_int8)
+from ..utils.logging import log_dist
+
+
+@jax.tree_util.register_pytree_node_class
+class WOQLeaf:
+    """One packed weight: quantized ints + per-group scales, static metadata."""
+
+    def __init__(self, q, s, bits: int, size: int, shape: Tuple[int, ...]):
+        self.q = q
+        self.s = s
+        self.bits = bits
+        self.size = size
+        self.shape = tuple(shape)
+
+    def tree_flatten(self):
+        return (self.q, self.s), (self.bits, self.size, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, s = children
+        bits, size, shape = aux
+        return cls(q, s, bits, size, shape)
+
+    def __repr__(self):
+        return f"WOQLeaf(int{self.bits}, shape={self.shape})"
+
+
+def is_woq_leaf(x) -> bool:
+    return isinstance(x, WOQLeaf)
+
+
+def quantize_leaf(w, bits: int = 8, group_size: int = 128) -> WOQLeaf:
+    """Pack one weight into quantized ints + scales."""
+    if bits == 8:
+        q, s, n = quantize_int8(w, group_size)
+    elif bits == 4:
+        q, s, n = quantize_int4(w, group_size)
+    else:
+        raise ValueError(f"WOQ supports 4/8 bits, got {bits}")
+    return WOQLeaf(q, s, bits, int(n), tuple(np.shape(w)))
+
+
+def dequantize_leaf(leaf: WOQLeaf, dtype=jnp.bfloat16):
+    fn = dequantize_int8 if leaf.bits == 8 else dequantize_int4
+    return fn(leaf.q, leaf.s, leaf.size, shape=leaf.shape, dtype=dtype)
+
+
+def quantize_tree(params: Any, bits: int = 8, group_size: int = 128,
+                  modules: Optional[Sequence[str]] = None,
+                  min_size: int = 4096) -> Any:
+    """Pack every matching >=2D leaf (reference
+    _init_group_wise_weight_quantization walks matched module names the same
+    way).  ``modules``: regexes over dotted leaf paths; None matches all.
+    Small leaves (norms, biases) stay dense."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def key_of(path):
+        return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    n_packed, dense_bytes, packed_bytes = 0, 0, 0
+    out = []
+    for path, leaf in flat:
+        key = key_of(path)
+        matchable = (np.ndim(leaf) >= 2 and np.size(leaf) >= min_size
+                     and (modules is None or any(re.search(m, key) for m in modules)))
+        if matchable:
+            packed = quantize_leaf(leaf, bits=bits, group_size=group_size)
+            n_packed += 1
+            dense_bytes += np.size(leaf) * 2  # vs bf16 serving copy
+            packed_bytes += int(np.size(packed.q) + np.size(packed.s) * 4)
+            out.append(packed)
+        else:
+            out.append(leaf)
+    log_dist(f"WOQ int{bits}: packed {n_packed} weights "
+             f"({dense_bytes / 1e6:.1f} MB bf16 -> {packed_bytes / 1e6:.1f} MB packed)",
+             ranks=[0])
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Restore a dense tree — call INSIDE jit so XLA fuses dequantization
+    into consumers and frees each layer's dense weights after use."""
+    return jax.tree_util.tree_map(
+        lambda leaf: dequantize_leaf(leaf, dtype) if is_woq_leaf(leaf) else leaf,
+        params, is_leaf=is_woq_leaf)
+
+
+def packed_nbytes(params: Any) -> int:
+    """Serving-resident bytes of a (possibly partially) packed tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_woq_leaf):
+        if is_woq_leaf(leaf):
+            total += int(np.size(leaf.q) + np.size(leaf.s) * 4)
+        else:
+            total += int(np.size(leaf)) * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+    return total

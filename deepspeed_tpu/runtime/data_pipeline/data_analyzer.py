@@ -121,29 +121,30 @@ class DataAnalyzer:
                 with open(self._out_prefix(name, "sum") + ".json", "w") as fh:
                     json.dump({"sum": total}, fh)
                 continue
-            builder = MMapIndexedDatasetBuilder(self._out_prefix(name, "sample_to_metric"),
-                                                dtype=np.int64)
-            values: List[np.ndarray] = []
+            # chunked byte-level merge (merge_file_), not per-sample python
+            out_prefix = self._out_prefix(name, "sample_to_metric")
+            builder = MMapIndexedDatasetBuilder(out_prefix, dtype=np.int64)
             for w in range(self.num_workers):
-                part = MMapIndexedDataset(self._partial_prefix(name, w))
-                for i in range(len(part)):
-                    builder.add_item(part[i])
-                    values.append(np.asarray(part[i]))
-            builder.end_document()
+                builder.merge_file_(self._partial_prefix(name, w))
             builder.finalize()
-            flat = np.concatenate(values) if values else np.zeros(0, np.int64)
-            buckets: Dict[int, List[int]] = {}
-            for idx, v in enumerate(flat.tolist()):
-                buckets.setdefault(int(v), []).append(idx)
+            merged = MMapIndexedDataset(out_prefix)
+            # every sample is one scalar -> the .bin IS the flat value array
+            flat = np.frombuffer(merged._data, np.int64, count=len(merged))
+            # vectorized inverse index: one stable argsort, split at value runs
+            order = np.argsort(flat, kind="stable")
+            vals, starts = np.unique(flat[order], return_index=True)
+            bounds = np.append(starts, len(order))
             np.savez(self._out_prefix(name, "metric_to_sample") + ".npz",
-                     **{str(k): np.asarray(v, np.int64) for k, v in buckets.items()})
+                     **{str(int(v)): order[bounds[i]:bounds[i + 1]].astype(np.int64)
+                        for i, v in enumerate(vals)})
         logger.info(f"DataAnalyzer reduce: wrote index files to {self.save_path}")
 
     # ------------------------------------------------------------- loading
     @staticmethod
     def load_sample_to_metric(save_path: str, metric_name: str) -> np.ndarray:
         ds = MMapIndexedDataset(os.path.join(save_path, f"{metric_name}_sample_to_metric"))
-        return np.asarray([int(ds[i][0]) for i in range(len(ds))], np.int64)
+        # one scalar per sample: the data buffer is the value array
+        return np.frombuffer(ds._data, np.int64, count=len(ds)).copy()
 
     @staticmethod
     def load_metric_to_sample(save_path: str, metric_name: str) -> Dict[int, np.ndarray]:

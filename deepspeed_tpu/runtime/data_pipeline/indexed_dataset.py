@@ -102,7 +102,7 @@ class MMapIndexedDataset:
         ptr, size = int(self._pointers[idx]), int(self._sizes[idx])
         if length is None:
             length = size - offset
-        if offset < 0 or offset + length > size:
+        if offset < 0 or length < 0 or offset + length > size:
             raise IndexError(f"window [{offset}, {offset + length}) outside sample of size {size}")
         return np.frombuffer(self._data, self._dtype, count=length,
                              offset=ptr + offset * self._dtype.itemsize)

@@ -144,3 +144,115 @@ def test_eigenvalue_power_iteration_quadratic():
     eig = Eigenvalue(max_iter=50, tol=1e-4)
     out = eig.compute_eigenvalue(loss_fn, {"x": jnp.asarray([1.0, 1.0, 1.0])}, None)
     assert abs(out["eigenvalue"] - 5.0) < 0.05
+
+
+def test_head_prune_mask_whole_heads():
+    from deepspeed_tpu.compression import head_prune_mask
+    rng = np.random.default_rng(0)
+    H, hd, dm = 4, 8, 32
+    w = jnp.asarray(rng.normal(size=(H * hd, dm)).astype(np.float32))
+    m = np.asarray(head_prune_mask(w, num_heads=H, density=0.5, head_axis="in"))
+    per_head = m.reshape(H, hd, dm)
+    # each head fully kept or fully zero, exactly 2 of 4 kept
+    kept = [bool(per_head[h].all()) for h in range(H)]
+    zeroed = [bool((per_head[h] == 0).all()) for h in range(H)]
+    assert all(k or z for k, z in zip(kept, zeroed))
+    assert sum(kept) == 2
+    # out-axis variant: columns grouped by head
+    m2 = np.asarray(head_prune_mask(w.T, num_heads=H, density=0.5, head_axis="out"))
+    assert m2.T.reshape(H, hd, dm).sum(axis=(1, 2)).tolist() == per_head.sum(axis=(1, 2)).tolist()
+
+
+def test_channel_prune_and_quant_act():
+    from deepspeed_tpu.compression import QuantAct, channel_prune_mask
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    m = np.asarray(channel_prune_mask(w, 0.5))
+    rows = m.sum(axis=1)
+    assert set(rows.tolist()) <= {0.0, 8.0} and rows.sum() == 8 * 8
+    x = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    q = QuantAct(bits=8, dynamic=True)(x)
+    assert float(jnp.abs(q - x).max()) < float(jnp.abs(x).max()) / 50
+    # static mode: calibrate, freeze, reuse
+    qa = QuantAct(bits=8, dynamic=False)
+    qa(x); qa(x * 2)
+    qa.freeze()
+    frozen_max = qa.running_max
+    qa(x * 100)  # frozen: range must not move
+    assert qa.running_max == frozen_max
+
+
+def test_layer_reduction_and_redundancy_clean():
+    from deepspeed_tpu.compression import layer_reduction, redundancy_clean
+    stacked = {"w": jnp.arange(6 * 4).reshape(6, 4).astype(jnp.float32)}
+    student = layer_reduction(stacked, [0, 2, 4])
+    np.testing.assert_array_equal(np.asarray(student["w"][:, 0]), [0, 8, 16])
+    # redundancy_clean with layer_reduction section drops teacher layers
+    params = {"blocks": {"w": jnp.ones((6, 4, 4))}, "head": jnp.ones((4, 4))}
+    out = redundancy_clean(params, {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 3, "teacher_layer": 6,
+        "module_name_prefix": "blocks"}})
+    assert out["blocks"]["w"].shape == (3, 4, 4)
+    assert out["head"].shape == (4, 4)
+
+
+def test_init_compression_head_and_channel_groups():
+    from deepspeed_tpu.compression import init_compression
+    rng = np.random.default_rng(2)
+    params = {"attn": {"wo": jnp.asarray(rng.normal(size=(32, 32)).astype(np.float32))},
+              "mlp": {"w": jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32))}}
+    cfg = {"head_pruning": {"shared_parameters": {"num_heads": 4},
+                            "different_groups": {"h": {"params": {"dense_ratio": 0.5},
+                                                       "modules": ["attn.wo"]}}},
+           "channel_pruning": {"different_groups": {"c": {"params": {"dense_ratio": 0.5},
+                                                          "modules": ["mlp"]}}}}
+    out = init_compression(params, cfg)
+    wo = np.asarray(out["attn"]["wo"]).reshape(4, 8, 32)
+    assert sum(bool((wo[h] == 0).all()) for h in range(4)) == 2
+    mlp_rows = np.asarray(out["mlp"]["w"]).sum(axis=1)
+    assert (mlp_rows == 0).sum() == 16
+
+
+# ----------------------------------------------------------------------- WOQ
+def test_woq_pack_dequant_roundtrip():
+    from deepspeed_tpu.inference.quantization import (dequantize_tree, packed_nbytes,
+                                                      quantize_tree)
+    rng = np.random.default_rng(3)
+    params = {"w1": jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32)),
+              "norm": jnp.ones((64,), jnp.float32)}
+    packed = quantize_tree(params, bits=8, group_size=64)
+    from deepspeed_tpu.inference.quantization import is_woq_leaf
+    assert is_woq_leaf(packed["w1"]) and not is_woq_leaf(packed["norm"])
+    # packed rest size ~ 1/4 the bf16 dense size + scales
+    assert packed_nbytes(packed) < params["w1"].size * 2
+    dense = dequantize_tree(packed, dtype=jnp.float32)
+    err = np.abs(np.asarray(dense["w1"]) - np.asarray(params["w1"])).max()
+    assert err < np.abs(np.asarray(params["w1"])).max() / 50
+    np.testing.assert_array_equal(np.asarray(dense["norm"]), np.ones(64))
+
+
+def test_woq_int4_inside_jit():
+    from deepspeed_tpu.inference.quantization import dequantize_tree, quantize_tree
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32))
+    packed = quantize_tree({"w": w}, bits=4, group_size=64)
+
+    @jax.jit
+    def matmul(p, x):
+        dense = dequantize_tree(p, dtype=jnp.float32)
+        return x @ dense["w"]
+
+    x = jnp.ones((2, 64))
+    out = matmul(packed, x)
+    ref = x @ w
+    # int4 tolerance: ~6% of magnitude
+    assert float(jnp.abs(out - ref).max()) < float(jnp.abs(ref).max()) * 0.2
+
+
+def test_layer_reduction_rejects_mixed_tree():
+    from deepspeed_tpu.compression import layer_reduction
+    mixed = {"blocks": jnp.ones((6, 4)), "embed": jnp.ones((32000, 8))}
+    with pytest.raises(ValueError, match="homogeneous"):
+        layer_reduction(mixed, [0, 2])
+    with pytest.raises(ValueError, match="out of range"):
+        layer_reduction({"w": jnp.ones((4, 4))}, [0, 9])

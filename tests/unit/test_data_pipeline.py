@@ -149,3 +149,10 @@ def test_float_metric_rejected(tmp_path):
                       save_path=str(tmp_path / "anx"))
     with pytest.raises(ValueError, match="non-integral"):
         an.run_map()
+
+
+def test_get_rejects_bad_offset(tmp_path):
+    _build_corpus(tmp_path / "g", [np.arange(3, dtype=np.int32)])
+    ds = MMapIndexedDataset(str(tmp_path / "g"))
+    with pytest.raises(IndexError):
+        ds.get(0, offset=10)  # offset past sample must not leak neighbors
