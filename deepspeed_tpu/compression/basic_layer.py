@@ -25,10 +25,13 @@ def head_prune_mask(w: jnp.ndarray, num_heads: int, density: float,
     ``w`` is a 2D projection; heads tile the ``in`` (dim 0, the wo case: rows
     are head_dim-sized groups of the attention output) or ``out`` axis (dim 1,
     the wq/wk/wv case).  Heads are ranked by L1 norm; the weakest are zeroed
-    whole, keeping ``density`` fraction.
+    whole, keeping ``density`` fraction.  3D scan-stacked leaves [L, d, d]
+    (this repo's model layout) prune each layer independently via vmap.
     """
+    if w.ndim == 3:
+        return jax.vmap(lambda lw: head_prune_mask(lw, num_heads, density, head_axis))(w)
     if w.ndim != 2:
-        raise ValueError("head pruning applies to 2D projections")
+        raise ValueError("head pruning applies to 2D projections (or [L, d, d] stacks)")
     axis = 0 if head_axis == "in" else 1
     if w.shape[axis] % num_heads != 0:
         raise ValueError(f"axis {axis} size {w.shape[axis]} not divisible by {num_heads} heads")
@@ -61,10 +64,13 @@ def channel_prune_mask(w: jnp.ndarray, density: float) -> jnp.ndarray:
 class QuantAct:
     """Activation fake-quantizer (reference QuantAct, basic_layer.py:41).
 
-    ``dynamic`` computes the range per call; static mode tracks a running
-    max (momentum EMA) that freezes for inference — call ``freeze()`` after
-    calibration.  Usage: wrap activations, e.g. ``x = qact(x)`` inside the
-    model's forward.
+    ``dynamic=True`` computes the range per call from the traced activation —
+    safe anywhere, including inside jit.  Static mode tracks a running max
+    (momentum EMA) on the HOST: calibrate by calling it on concrete arrays
+    (eager forward passes), then ``freeze()``; the frozen scale is a Python
+    constant, so the frozen quantizer IS jit-safe.  Calibrating inside a
+    jitted function cannot work (host state can't update under trace) and
+    raises instead of silently mis-calibrating.
     """
 
     def __init__(self, bits: int = 8, dynamic: bool = True, momentum: float = 0.95):
@@ -83,6 +89,11 @@ class QuantAct:
             scale = jnp.maximum(jnp.abs(x).max(), 1e-8) / qmax
         else:
             if not self.frozen:
+                if isinstance(x, jax.core.Tracer):
+                    raise RuntimeError(
+                        "QuantAct static calibration saw a traced array — run "
+                        "calibration passes EAGERLY (outside jit), then freeze(); "
+                        "or use dynamic=True for in-jit ranges")
                 cur = float(jnp.abs(x).max())
                 self.running_max = (cur if self.running_max is None else
                                     self.momentum * self.running_max +

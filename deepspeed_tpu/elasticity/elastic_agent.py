@@ -1,0 +1,147 @@
+"""Elastic agent: worker supervision with restart + world rescaling.
+
+Analog of the reference ``DSElasticAgent`` (deepspeed/elasticity/
+elastic_agent.py:28, extending torch-elastic's LocalElasticAgent): spawn the
+training workers, monitor them, and on failure re-form the world at a size
+the elasticity config permits, then restart from the latest checkpoint.
+Without torch-elastic's rendezvous store, membership is what the agent itself
+launches (single-host supervisor; multi-host agents coordinate via the
+launcher's hostfile + per-host agents), and the "valid world sizes" come from
+the same solver the config uses (elasticity.py ``get_valid_gpus``).
+
+Workers see: RANK, WORLD_SIZE, DSTPU_ELASTIC_RESTART (restart ordinal) — a
+worker resumes from its checkpoint exactly as after a cold restart, which is
+the reference's recovery model too (elastic training = checkpoint + relaunch
+at a new valid batch/world configuration).
+"""
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import get_valid_gpus
+
+
+class WorkerGroup:
+    """One generation of worker processes."""
+
+    def __init__(self, procs: List[subprocess.Popen], world_size: int, restart: int):
+        self.procs = procs
+        self.world_size = world_size
+        self.restart = restart
+
+    def poll_failed(self) -> Optional[int]:
+        """Return an exit code if any worker failed, else None."""
+        for p in self.procs:
+            rc = p.poll()
+            if rc is not None and rc != 0:
+                return rc
+        return None
+
+    def all_done(self) -> bool:
+        return all(p.poll() == 0 for p in self.procs)
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+class DSElasticAgent:
+    """Supervise `world_size` copies of a worker command.
+
+    ``elastic_config``: the ds-config ``elasticity`` section (max batch,
+    micro-batches, min/max gpus) constraining which world sizes are valid.
+    On a worker failure the agent assumes capacity loss, drops to the next
+    smaller valid world size, and relaunches (up to ``max_restarts``).
+    """
+
+    def __init__(self, worker_cmd: Sequence[str], world_size: int,
+                 elastic_config: Optional[Dict] = None, max_restarts: int = 3,
+                 poll_interval: float = 0.2, env: Optional[Dict[str, str]] = None):
+        self.worker_cmd = list(worker_cmd)
+        self.initial_world = world_size
+        self.elastic_config = elastic_config
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.base_env = dict(env or os.environ)
+        self.restart_count = 0
+
+    # ------------------------------------------------------------- world math
+    def valid_world_sizes(self) -> List[int]:
+        if not self.elastic_config:
+            return list(range(1, self.initial_world + 1))
+        cfg = dict(self.elastic_config)
+        valid = get_valid_gpus(
+            int(cfg["max_train_batch_size"]),
+            [int(m) for m in cfg["micro_batch_sizes"]],
+            int(cfg.get("min_gpus", 1)),
+            int(cfg.get("max_gpus", self.initial_world)))
+        return sorted(w for w in valid if w <= self.initial_world)
+
+    def next_world_size(self, current: int) -> Optional[int]:
+        smaller = [w for w in self.valid_world_sizes() if w < current]
+        return max(smaller) if smaller else None
+
+    # --------------------------------------------------------------- spawning
+    def _spawn(self, world_size: int) -> WorkerGroup:
+        procs = []
+        for rank in range(world_size):
+            env = dict(self.base_env,
+                       RANK=str(rank), WORLD_SIZE=str(world_size),
+                       DSTPU_ELASTIC_RESTART=str(self.restart_count))
+            procs.append(subprocess.Popen(self.worker_cmd, env=env))
+        logger.info(f"elastic agent: launched {world_size} workers "
+                    f"(restart {self.restart_count})")
+        return WorkerGroup(procs, world_size, self.restart_count)
+
+    # -------------------------------------------------------------------- run
+    def run(self) -> int:
+        """Supervise until success (0), unrecoverable failure (worker rc), or
+        restart budget exhausted (1)."""
+        world = self.initial_world
+        valid = self.valid_world_sizes()
+        if world not in valid:
+            # launching at a size the elastic config forbids breaks the batch
+            # math from step 0 — clamp before the first generation
+            fitting = [w for w in valid if w <= world]
+            if not fitting:
+                logger.error(f"elastic agent: no valid world size <= {world} "
+                             f"(valid: {valid})")
+                return 1
+            logger.warning(f"elastic agent: world_size {world} is not elastic-valid "
+                           f"{valid}; clamping to {max(fitting)}")
+            world = max(fitting)
+        group = self._spawn(world)
+        while True:
+            time.sleep(self.poll_interval)
+            rc = group.poll_failed()
+            if rc is not None:
+                logger.warning(f"elastic agent: worker failed rc={rc} "
+                               f"(world={world}, restart {self.restart_count})")
+                group.terminate()
+                if self.restart_count >= self.max_restarts:
+                    logger.error("elastic agent: restart budget exhausted")
+                    return 1
+                self.restart_count += 1
+                shrunk = self.next_world_size(world)
+                if shrunk is not None:
+                    logger.info(f"elastic agent: rescaling {world} -> {shrunk}")
+                    world = shrunk
+                elif world not in self.valid_world_sizes():
+                    logger.error(f"elastic agent: no valid world size <= {world}")
+                    return 1
+                group = self._spawn(world)
+                continue
+            if group.all_done():
+                logger.info("elastic agent: all workers finished cleanly")
+                return 0

@@ -54,6 +54,10 @@ class InferenceEngine:
 
         self._quantized = self.config.quant.enabled
         if self._quantized:
+            if self.topology.axis_size(TENSOR_AXIS) > 1:
+                log_dist("WARNING: quant.enabled serves weights REPLICATED — "
+                         "packed layouts do not yet follow the TP sharding plan; "
+                         "tp_size > 1 buys no memory here", ranks=[0])
             # real WOQ: weights live PACKED (int8/int4 + scales) in device
             # memory; the jitted forward dequantizes per layer on the fly
             # (inference/quantization.py).  Packed leaves replicate — TP

@@ -161,6 +161,97 @@ class SSHRunner(MultiNodeRunner):
         return self.get_cmds(environment, active_resources)[0]
 
 
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun (Open MPI dialect) — reference multinode_runner.py:117.  One
+    rank per host; env rides ``-x`` exports; JAX's coordinator address comes
+    from the same payload the other runners use."""
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ompi_info") is not None and shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = len(active_resources)
+        exports = []
+        for k, v in sorted(environment.items()):
+            exports += ["-x", f"{k}={v}"]
+        hosts = ",".join(active_resources.keys())
+        cmd = ["mpirun", "-n", str(total), "--host", hosts, "--mca", "btl", "^openib"]
+        iface = getattr(self.args, "mpi_interface", "")
+        if iface:  # only pin the NIC when the user names one (eth0 is not universal)
+            cmd += ["--mca", "btl_tcp_if_include", iface]
+        return (cmd + exports
+                + [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch"]
+                + self.user_arguments)
+
+
+class MPICHRunner(MultiNodeRunner):
+    """mpirun (MPICH dialect, ``-genv`` exports) — reference :170."""
+    name = "mpich"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = len(active_resources)
+        exports = []
+        for k, v in sorted(environment.items()):
+            exports += ["-genv", k, str(v)]
+        hosts = ",".join(active_resources.keys())
+        return (["mpirun", "-n", str(total), "-hosts", hosts] + exports
+                + [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch"]
+                + self.user_arguments)
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun allocation launch — reference :327.  Env propagates via
+    ``--export`` (Slurm forwards it to every task)."""
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total = len(active_resources)
+        exports = "ALL," + ",".join(f"{k}={v}" for k, v in sorted(environment.items()))
+        cmd = ["srun", "-n", str(total)]
+        if active_resources:
+            cmd += ["-w", ",".join(active_resources.keys())]
+        if getattr(self.args, "slurm_comment", ""):
+            cmd += ["--comment", self.args.slurm_comment]
+        cmd += [f"--export={exports}", sys.executable, "-u", "-m",
+                "deepspeed_tpu.launcher.launch"] + self.user_arguments
+        return cmd
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """mpirun_rsh (MVAPICH2) — reference :375; env as KEY=VALUE operands.
+    mpirun_rsh wants a bare-hostname file (no ``slots=N`` tokens), so the
+    runner writes one from the already include/exclude-filtered resources —
+    the reference writes /tmp/mvapich_hostfile the same way (:392)."""
+    name = "mvapich"
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        import tempfile
+        total = len(active_resources)
+        fh = tempfile.NamedTemporaryFile("w", prefix="dstpu_mvapich_hosts_",
+                                         suffix=".txt", delete=False)
+        fh.write("\n".join(active_resources.keys()) + "\n")
+        fh.close()
+        env_kv = [f"{k}={v}" for k, v in sorted(environment.items())]
+        return (["mpirun_rsh", "-np", str(total), "-hostfile", fh.name]
+                + env_kv + [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch"]
+                + self.user_arguments)
+
+
+RUNNER_CLASSES = {cls.name: cls for cls in
+                  (PDSHRunner, SSHRunner, OpenMPIRunner, MPICHRunner,
+                   SlurmRunner, MVAPICHRunner)}
+
+
 def build_launch_env(resources: Dict[str, int], master_addr: str, master_port: int) -> Dict[str, str]:
     return {
         "DSTPU_WORLD_INFO": encode_world_info(resources),
@@ -177,15 +268,20 @@ def main(argv=None):
     parser.add_argument("--exclude", default="")
     parser.add_argument("--master_addr", default=None)
     parser.add_argument("--master_port", type=int, default=29500)
-    parser.add_argument("--launcher", default="pdsh", choices=("pdsh", "ssh", "local"))
+    parser.add_argument("--launcher", default="pdsh",
+                        choices=("pdsh", "ssh", "local", "openmpi", "mpich", "slurm", "mvapich"))
+    parser.add_argument("--slurm_comment", default="")
+    parser.add_argument("--mpi_interface", default="",
+                        help="NIC for Open MPI's TCP BTL (omit to let OMPI pick)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
-    multi_node = os.path.isfile(args.hostfile) or args.force_multi
+    # --launcher local always runs on this host, hostfile or not
+    multi_node = (os.path.isfile(args.hostfile) or args.force_multi) and args.launcher != "local"
     if not multi_node:
-        logger.info("no hostfile: launching locally (single host, all local chips)")
+        logger.info("launching locally (single host, all local chips)")
         cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
         return subprocess.call(cmd)
 
@@ -200,6 +296,8 @@ def main(argv=None):
         if not runner.backend_exists():
             logger.warning("pdsh not found; falling back to ssh")
             runner = SSHRunner(args, resources)
+    elif args.launcher in RUNNER_CLASSES and args.launcher != "ssh":
+        runner = RUNNER_CLASSES[args.launcher](args, resources)
     else:
         runner = SSHRunner(args, resources)
     if not runner.backend_exists():

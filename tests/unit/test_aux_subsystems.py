@@ -256,3 +256,25 @@ def test_layer_reduction_rejects_mixed_tree():
         layer_reduction(mixed, [0, 2])
     with pytest.raises(ValueError, match="out of range"):
         layer_reduction({"w": jnp.ones((4, 4))}, [0, 9])
+
+
+def test_head_prune_mask_stacked_layers():
+    from deepspeed_tpu.compression import head_prune_mask
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.normal(size=(3, 16, 8)).astype(np.float32))  # [L, d, d]
+    m = np.asarray(head_prune_mask(w, num_heads=4, density=0.5, head_axis="in"))
+    for l in range(3):
+        per_head = m[l].reshape(4, 4, 8)
+        assert sum(bool(per_head[h].all()) for h in range(4)) == 2
+
+
+def test_quant_act_static_rejects_tracer():
+    from deepspeed_tpu.compression import QuantAct
+    qa = QuantAct(bits=8, dynamic=False)
+    with pytest.raises(RuntimeError, match="EAGERLY"):
+        jax.jit(qa)(jnp.ones((4, 4)))
+    # frozen static mode IS jit-safe
+    qa(jnp.ones((4, 4)))
+    qa.freeze()
+    out = jax.jit(qa)(jnp.ones((4, 4)))
+    assert np.isfinite(np.asarray(out)).all()

@@ -1,0 +1,63 @@
+"""Elastic agent tests — reference analog: DSElasticAgent restart/rescale
+(elastic_agent.py:28); here with real subprocess workers."""
+
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity import DSElasticAgent
+
+ELASTIC = {"max_train_batch_size": 8, "micro_batch_sizes": [1, 2],
+           "min_gpus": 1, "max_gpus": 8}
+
+
+def test_valid_world_sizes_from_config():
+    agent = DSElasticAgent(["true"], world_size=8, elastic_config=ELASTIC)
+    assert agent.valid_world_sizes() == [1, 2, 4, 8]
+    assert agent.next_world_size(8) == 4
+    assert agent.next_world_size(1) is None
+
+
+def test_clean_run_exits_zero(tmp_path):
+    agent = DSElasticAgent([sys.executable, "-c", "import os; assert 'RANK' in os.environ"],
+                           world_size=2, poll_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restart_count == 0
+
+
+def test_failure_rescales_and_recovers(tmp_path):
+    """Workers fail while a flag file is present (simulated lost capacity at
+    world=4); the agent drops to the next valid size and succeeds."""
+    flag = tmp_path / "broken"
+    flag.write_text("x")
+    script = (
+        "import os, sys\n"
+        f"flag = {str(flag)!r}\n"
+        "world = int(os.environ['WORLD_SIZE'])\n"
+        "if os.path.exists(flag) and world >= 4:\n"
+        "    if os.environ['RANK'] == '3':\n"
+        "        sys.exit(13)\n"
+        "    import time; time.sleep(5)\n"  # healthy peers linger; agent kills them
+        "sys.exit(0)\n")
+    agent = DSElasticAgent([sys.executable, "-c", script], world_size=4,
+                           elastic_config=ELASTIC, max_restarts=2, poll_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+
+
+def test_restart_budget_exhausted(tmp_path):
+    agent = DSElasticAgent([sys.executable, "-c", "import sys; sys.exit(7)"],
+                           world_size=2, elastic_config=ELASTIC,
+                           max_restarts=1, poll_interval=0.05)
+    assert agent.run() == 1
+    assert agent.restart_count == 1
+
+
+def test_initial_world_clamped_to_valid():
+    """world_size not permitted by the elastic config clamps before launch."""
+    import os
+    agent = DSElasticAgent(
+        [sys.executable, "-c",
+         "import os, sys; sys.exit(0 if os.environ['WORLD_SIZE'] == '4' else 3)"],
+        world_size=6, elastic_config=ELASTIC, poll_interval=0.05)
+    assert agent.run() == 0

@@ -62,3 +62,68 @@ def test_ssh_cmds_have_ranks():
     cmds = r.get_cmds({"NUM_PROCESSES": "2"}, {"h1": 4, "h2": 4})
     assert len(cmds) == 2
     assert "PROCESS_ID=0" in cmds[0][-1] and "PROCESS_ID=1" in cmds[1][-1]
+
+
+# ----------------------------------------------------- multinode runner cmds
+def _args(**kw):
+    import argparse
+    ns = argparse.Namespace(user_script="train.py", user_args=["--lr", "1"],
+                            hostfile="/job/hostfile", slurm_comment="")
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_openmpi_runner_cmd():
+    from deepspeed_tpu.launcher.runner import OpenMPIRunner
+    r = OpenMPIRunner(_args(), {"hostA": 4, "hostB": 4})
+    cmd = r.get_cmd({"DSTPU_WORLD_INFO": "abc"}, {"hostA": 4, "hostB": 4})
+    assert cmd[0] == "mpirun" and cmd[1:3] == ["-n", "2"]
+    assert "--host" in cmd and cmd[cmd.index("--host") + 1] == "hostA,hostB"
+    assert "-x" in cmd and "DSTPU_WORLD_INFO=abc" in cmd
+    assert cmd[-4:] == ["deepspeed_tpu.launcher.launch", "train.py", "--lr", "1"]
+
+
+def test_mpich_runner_cmd():
+    from deepspeed_tpu.launcher.runner import MPICHRunner
+    cmd = MPICHRunner(_args(), {"h1": 1}).get_cmd({"K": "V"}, {"h1": 1})
+    assert cmd[:3] == ["mpirun", "-n", "1"]
+    i = cmd.index("-genv")
+    assert cmd[i + 1:i + 3] == ["K", "V"]
+
+
+def test_slurm_runner_cmd():
+    from deepspeed_tpu.launcher.runner import SlurmRunner
+    cmd = SlurmRunner(_args(slurm_comment="prod"), {"n1": 4, "n2": 4}).get_cmd(
+        {"A": "1"}, {"n1": 4, "n2": 4})
+    assert cmd[:3] == ["srun", "-n", "2"]
+    assert cmd[cmd.index("-w") + 1] == "n1,n2"
+    assert "--comment" in cmd and "prod" in cmd
+    assert any(c.startswith("--export=ALL,A=1") for c in cmd)
+
+
+def test_mvapich_runner_cmd():
+    from deepspeed_tpu.launcher.runner import MVAPICHRunner
+    cmd = MVAPICHRunner(_args(), {"h": 1}).get_cmd({"E": "2"}, {"h": 1})
+    assert cmd[:3] == ["mpirun_rsh", "-np", "1"]
+    assert "E=2" in cmd and "-hostfile" in cmd
+
+
+def test_runner_registry_covers_launcher_choices():
+    from deepspeed_tpu.launcher.runner import RUNNER_CLASSES
+    assert set(RUNNER_CLASSES) == {"pdsh", "ssh", "openmpi", "mpich", "slurm", "mvapich"}
+
+
+def test_mvapich_writes_bare_hostfile(tmp_path):
+    from deepspeed_tpu.launcher.runner import MVAPICHRunner
+    cmd = MVAPICHRunner(_args(), {"h1": 8, "h2": 8}).get_cmd({}, {"h1": 8, "h2": 8})
+    hf = cmd[cmd.index("-hostfile") + 1]
+    assert open(hf).read().split() == ["h1", "h2"]  # bare names, filtered set
+
+
+def test_openmpi_interface_flag_optional():
+    from deepspeed_tpu.launcher.runner import OpenMPIRunner
+    cmd = OpenMPIRunner(_args(), {"h": 1}).get_cmd({}, {"h": 1})
+    assert "btl_tcp_if_include" not in cmd
+    cmd = OpenMPIRunner(_args(mpi_interface="ens5"), {"h": 1}).get_cmd({}, {"h": 1})
+    assert cmd[cmd.index("btl_tcp_if_include") + 1] == "ens5"
