@@ -92,13 +92,118 @@ class TpuAccelerator(Accelerator):
     def empty_cache(self):
         pass  # XLA owns allocation; no-op (reference empties the CUDA cache)
 
+    # -- streams / events -----------------------------------------------------
+    # XLA dispatch is a single async stream per device; Stream is an ordering
+    # no-op and Event timestamps by draining it (the reference's CudaEventTimer
+    # contract, utils/timer.py:31 — elapsed() returns milliseconds).
+    class Stream:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def synchronize(self):
+            jnp.zeros(()).block_until_ready()
+
+    class Event:
+        def __init__(self, enable_timing: bool = True):
+            self._t = None
+
+        def record(self, stream=None):
+            import time as _time
+            jnp.zeros(()).block_until_ready()  # drain dispatch first
+            self._t = _time.perf_counter()
+
+        def synchronize(self):
+            jnp.zeros(()).block_until_ready()
+
+        def elapsed_time(self, end_event) -> float:
+            if self._t is None or end_event._t is None:
+                raise RuntimeError("elapsed_time needs both events recorded")
+            return (end_event._t - self._t) * 1e3
+
+    def stream(self, stream=None):
+        return self.Stream()
+
+    def current_stream(self, device_index=None):
+        return self.Stream()
+
+    def default_stream(self, device_index=None):
+        return self.Stream()
+
+    # -- graph capture --------------------------------------------------------
+    # jit IS the graph capture: create returns a callable cache, capture
+    # compiles, replay calls the compiled function (reference
+    # create_graph/capture_to_graph/replay_graph).
+    def create_graph(self):
+        return {}
+
+    def capture_to_graph(self, graph, fn, *args, **kwargs):
+        graph["fn"] = jax.jit(fn)
+        graph["out"] = graph["fn"](*args, **kwargs)
+        return graph["out"]
+
+    def replay_graph(self, graph, *args, **kwargs):
+        return graph["fn"](*args, **kwargs)
+
+    # -- pinned memory --------------------------------------------------------
+    def pin_memory(self, array):
+        """Host-resident contiguous staging buffer (the reference pins CUDA
+        host memory; XLA's host->TPU DMA path wants contiguous numpy)."""
+        import numpy as _np
+        return _np.ascontiguousarray(array)
+
+    def is_pinned(self, array) -> bool:
+        import numpy as _np
+        return isinstance(array, _np.ndarray) and array.flags["C_CONTIGUOUS"]
+
+    # -- profiler ranges ------------------------------------------------------
+    def range_push(self, name: str):
+        self._ranges = getattr(self, "_ranges", [])
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        self._ranges.append(ann)
+
+    def range_pop(self):
+        if getattr(self, "_ranges", []):
+            self._ranges.pop().__exit__(None, None, None)
+
+    # -- device properties ----------------------------------------------------
+    def get_device_properties(self, device_index=None) -> dict:
+        dev = jax.local_devices()[device_index or 0]
+        return {"name": getattr(dev, "device_kind", self._platform()),
+                "platform": dev.platform,
+                "total_memory": self.total_memory(device_index),
+                "num_cores": getattr(dev, "num_cores", 1)}
+
     # -- communication --------------------------------------------------------
     def communication_backend_name(self) -> str:
         return "xla"
 
+    # -- op builders (reference accelerator op_builder resolution) -------------
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder"
+
+    def get_op_builder(self, class_name: str):
+        from ..ops import op_builder
+        return getattr(op_builder, class_name)
+
+    def create_op_builder(self, class_name: str):
+        return self.get_op_builder(class_name)()
+
     # -- rng ------------------------------------------------------------------
     def random_seed(self, seed: int):
         return jax.random.PRNGKey(seed)
+
+    def get_rng_state(self, key):
+        """JAX rng is an explicit key, not hidden device state; the 'state' IS
+        the key array (reference get_rng_state returns the CUDA RNG blob)."""
+        import numpy as _np
+        return _np.asarray(key)
+
+    def set_rng_state(self, state):
+        return jnp.asarray(state, jnp.uint32)
 
     def on_accelerator(self, array) -> bool:
         return isinstance(array, jax.Array)

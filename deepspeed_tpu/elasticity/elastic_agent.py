@@ -54,6 +54,7 @@ class WorkerGroup:
                 p.wait(timeout=max(0.1, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 p.kill()
+                p.wait()  # reap — the respawn must not race a dying worker
 
 
 class DSElasticAgent:
@@ -137,9 +138,7 @@ class DSElasticAgent:
                 if shrunk is not None:
                     logger.info(f"elastic agent: rescaling {world} -> {shrunk}")
                     world = shrunk
-                elif world not in self.valid_world_sizes():
-                    logger.error(f"elastic agent: no valid world size <= {world}")
-                    return 1
+                # world == min valid size: respawn at the same size
                 group = self._spawn(world)
                 continue
             if group.all_done():

@@ -190,7 +190,19 @@ class MPICHRunner(MultiNodeRunner):
     name = "mpich"
 
     def backend_exists(self) -> bool:
-        return shutil.which("mpirun") is not None
+        # Open MPI also installs an 'mpirun'; require an MPICH marker so the
+        # MPICH-only flags (-hosts/-genv) don't fail cryptically at runtime
+        if shutil.which("mpiexec.hydra") is not None:
+            return True
+        mpirun = shutil.which("mpirun")
+        if mpirun is None:
+            return False
+        try:
+            out = subprocess.run([mpirun, "--version"], capture_output=True,
+                                 text=True, timeout=5).stdout
+        except Exception:
+            return False
+        return "mpich" in out.lower() or "hydra" in out.lower()
 
     def get_cmd(self, environment, active_resources):
         total = len(active_resources)
@@ -241,6 +253,8 @@ class MVAPICHRunner(MultiNodeRunner):
                                          suffix=".txt", delete=False)
         fh.write("\n".join(active_resources.keys()) + "\n")
         fh.close()
+        import atexit
+        atexit.register(lambda p=fh.name: os.path.exists(p) and os.unlink(p))
         env_kv = [f"{k}={v}" for k, v in sorted(environment.items())]
         return (["mpirun_rsh", "-np", str(total), "-hostfile", fh.name]
                 + env_kv + [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch"]

@@ -67,6 +67,49 @@ def get_model_profile(loss_fn: Callable, params: Any, batch: Any,
     return res
 
 
+def per_module_profile(params: Any, tokens: int, top_k: int = 0):
+    """Per-module parameter/FLOP attribution (reference
+    ``print_model_profile:282`` — per-module MACs table).
+
+    The reference counts MACs analytically per nn.Module via forward hooks;
+    functional pytrees have no modules, so the unit of attribution is the
+    param subtree: every >=2D leaf is a projection applied once per token
+    (2 * tokens * nelem MACs->FLOPs, matmul dominance), 1D leaves are
+    elementwise.  Scan-stacked leaves [L, ...] count all L applications.
+    Returns rows [{'module', 'params', 'flops', 'flops_pct'}] sorted by
+    flops desc (all rows, or ``top_k``).
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+
+    def key_of(path):
+        return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    rows = []
+    for path, leaf in flat:
+        n = int(np.size(leaf))
+        if np.ndim(leaf) >= 2:
+            flops = 2.0 * tokens * n       # one matmul pass per token
+        else:
+            flops = float(tokens * max(n, 1))  # elementwise (norms, biases)
+        rows.append({"module": key_of(path), "params": n, "flops": flops})
+    total = sum(r["flops"] for r in rows) or 1.0
+    for r in rows:
+        r["flops_pct"] = 100.0 * r["flops"] / total
+    rows.sort(key=lambda r: r["flops"], reverse=True)
+    return rows[:top_k] if top_k else rows
+
+
+def format_module_table(rows, top_k: int = 10) -> str:
+    lines = [f"{'module':<48} {'params':>10} {'flops':>10} {'%':>6}"]
+    for r in rows[:top_k]:
+        lines.append(f"{r['module']:<48} {_num(r['params']):>10} "
+                     f"{_num(r['flops']):>10} {r['flops_pct']:>5.1f}%")
+    shown = sum(r['flops_pct'] for r in rows[:top_k])
+    if len(rows) > top_k:
+        lines.append(f"... {len(rows) - top_k} more modules ({100 - shown:.1f}% of flops)")
+    return "\n".join(lines)
+
+
 class FlopsProfiler:
     """Engine-attached profiler (reference FlopsProfiler lifecycle:
     start_profile/stop_profile/print_model_profile) reading XLA cost analysis
@@ -91,6 +134,10 @@ class FlopsProfiler:
                                      flops_per_param=float(cost.get("flops", 0.0)) / max(n_params, 1))
         return self._result
 
-    def print_model_profile(self):
+    def print_model_profile(self, tokens: Optional[int] = None, top_k: int = 10):
+        """Whole-program totals + per-module table (reference :282)."""
         if self._result is not None:
             log_dist(f"train-step profile: {self._result.human()}", ranks=[0])
+        if self.engine is not None and tokens is not None:
+            rows = per_module_profile(self.engine.state.params, tokens)
+            log_dist("\n" + format_module_table(rows, top_k=top_k), ranks=[0])

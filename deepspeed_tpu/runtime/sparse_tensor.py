@@ -1,0 +1,71 @@
+"""Sparse gradient reduction for embedding tables.
+
+Analog of the reference ``SparseTensor`` (runtime/sparse_tensor.py:12) and the
+engine's ``sparse_allreduce_bucket`` (engine.py:2462): embedding gradients are
+nonzero only on the rows a batch touched, so the reference reduces
+(indices, values) pairs with an allgather instead of a dense allreduce.
+
+TPU-native shape: a ``SparseTensor`` pytree of (indices [N], values [N, D],
+dense row count), and ``sparse_all_reduce`` — inside shard_map — allgathers
+both over the dp axis; the concatenation IS the sum, since scatter-add of the
+combined pairs equals adding the per-rank dense grads (the reference relies
+on the same identity, engine.py:2520 csr concat).  ``to_dense`` materializes
+via segment_sum.  Useful when batch-rows << vocab-rows; otherwise XLA's dense
+psum wins.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.mesh import DATA_AXIS
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseTensor:
+    """COO-ish rows-only sparse gradient: values[i] belongs to row indices[i]."""
+
+    def __init__(self, indices, values, dense_rows: int):
+        self.indices = indices
+        self.values = values
+        self.dense_rows = int(dense_rows)
+
+    def tree_flatten(self):
+        return (self.indices, self.values), (self.dense_rows,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    @classmethod
+    def from_dense_rows(cls, grad: jnp.ndarray, indices: jnp.ndarray) -> "SparseTensor":
+        """Select the touched rows of a dense grad (the embedding-bwd output
+        already scattered; batches know their token ids)."""
+        return cls(indices, jnp.take(grad, indices, axis=0), grad.shape[0])
+
+    def to_dense(self) -> jnp.ndarray:
+        """Scatter-add duplicate rows back to dense [rows, D]."""
+        return jax.ops.segment_sum(self.values, self.indices,
+                                   num_segments=self.dense_rows)
+
+    def nbytes(self) -> int:
+        return int(self.indices.size * 4 + self.values.size * self.values.dtype.itemsize)
+
+
+def sparse_all_reduce(st: SparseTensor, axis_name: str = DATA_AXIS) -> SparseTensor:
+    """Reduce a SparseTensor across ``axis_name`` (call inside shard_map):
+    allgather indices+values; concatenated pairs sum to the dense total on
+    every rank (reference sparse_allreduce:2462 allgather path)."""
+    idx = lax.all_gather(st.indices, axis_name, tiled=True)
+    vals = lax.all_gather(st.values, axis_name, tiled=True)
+    return SparseTensor(idx, vals, st.dense_rows)
+
+
+def embedding_grad_sparse(embed: jnp.ndarray, token_ids: jnp.ndarray,
+                          dout: jnp.ndarray) -> SparseTensor:
+    """Build the sparse gradient of an embedding lookup directly:
+    d(embed)[ids[i]] += dout[i].  ids [T], dout [T, D]."""
+    return SparseTensor(token_ids.reshape(-1), dout.reshape(-1, dout.shape[-1]),
+                        embed.shape[0])

@@ -6,9 +6,11 @@ The public surface mirrors ``deepspeed.zero``: ``Init`` / ``GatheredParameters``
 """
 
 from .init import GatheredParameters, Init, init, max_loader_bytes, reset_loader_stats
+from .tiling import TiledLinear, tiled_matmul
 from .sharding import ShardingPlan, build_sharding_plan
 
 __all__ = [
     "GatheredParameters", "Init", "init", "max_loader_bytes", "reset_loader_stats",
     "ShardingPlan", "build_sharding_plan",
+    "TiledLinear", "tiled_matmul",
 ]

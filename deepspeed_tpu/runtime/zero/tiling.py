@@ -1,0 +1,95 @@
+"""Tiled linear layers — bound the live activation/weight footprint of huge
+projections.
+
+Analog of the reference ``TiledLinear`` (runtime/zero/tiling.py:21): the
+reference splits one nn.Linear into a grid of sub-Linears so ZeRO-3 fetches
+one tile's weights at a time.  Under XLA the concern is the peak ACTIVATION
+of giant projections (a [tokens, vocab] unembed logit block can dwarf the
+model): ``tiled_matmul`` runs the output dimension in ``lax.map`` chunks so
+at most one [tokens, tile] block plus the running consumer is live, and under
+ZeRO-3 each tile's weight columns gather per chunk instead of all at once.
+
+``TiledLinear`` mirrors the reference's param-splitting form: weights stored
+pre-split [T, in, out/T], applied tile-by-tile — composes with zero.Init
+(each tile is an independently sharded leaf).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def tiled_matmul(x: jnp.ndarray, w: jnp.ndarray, num_tiles: int,
+                 reduce_fn: Optional[Callable] = None):
+    """``x @ w`` with the output dim computed in ``num_tiles`` sequential
+    chunks.  With ``reduce_fn`` (e.g. a per-chunk logsumexp/top-k consumer)
+    the full product never materializes — the softmax-over-vocab trick;
+    without it, chunks concatenate to the ordinary result."""
+    out_dim = w.shape[-1]
+    if out_dim % num_tiles != 0:
+        raise ValueError(f"output dim {out_dim} not divisible by {num_tiles} tiles")
+    tile = out_dim // num_tiles
+    wt = w.reshape(*w.shape[:-1], num_tiles, tile)
+    wt = jnp.moveaxis(wt, -2, 0)  # [T, in, tile]
+
+    if reduce_fn is None:
+        chunks = lax.map(lambda wi: x @ wi, wt)          # [T, ..., tile]
+        return _merge_tiles(chunks)
+    return lax.map(lambda wi: reduce_fn(x @ wi), wt)
+
+
+def _merge_tiles(chunks: jnp.ndarray) -> jnp.ndarray:
+    """[T, ..., tile] -> [..., T*tile] preserving tile order."""
+    moved = jnp.moveaxis(chunks, 0, -2)
+    return moved.reshape(*moved.shape[:-2], moved.shape[-2] * moved.shape[-1])
+
+
+class TiledLinear:
+    """Pre-split linear: params {'w_tiles': [T, in, out/T], 'b_tiles': [T, out/T]}.
+
+    ``init(key, in_dim, out_dim, num_tiles)`` builds the split params;
+    ``apply(params, x)`` is the tiled forward.  Reference parity: in_splits
+    are unnecessary under XLA (input-dim tiling is a plain reduction the
+    compiler already schedules); out_splits are the memory lever.
+    """
+
+    @staticmethod
+    def init(key, in_dim: int, out_dim: int, num_tiles: int, scale: Optional[float] = None,
+             bias: bool = True, dtype=jnp.float32):
+        if out_dim % num_tiles != 0:
+            raise ValueError(f"out_dim {out_dim} not divisible by {num_tiles}")
+        scale = scale if scale is not None else in_dim ** -0.5
+        w = jax.random.normal(key, (num_tiles, in_dim, out_dim // num_tiles), dtype) * scale
+        params = {"w_tiles": w}
+        if bias:
+            params["b_tiles"] = jnp.zeros((num_tiles, out_dim // num_tiles), dtype)
+        return params
+
+    @staticmethod
+    def apply(params, x):
+        def one(args):
+            if len(args) == 2:
+                w, b = args
+                return x @ w + b
+            (w,) = args
+            return x @ w
+
+        if "b_tiles" in params:
+            chunks = lax.map(one, (params["w_tiles"], params["b_tiles"]))
+        else:
+            chunks = lax.map(one, (params["w_tiles"],))
+        return _merge_tiles(chunks)
+
+    @staticmethod
+    def from_dense(w: jnp.ndarray, num_tiles: int, b: Optional[jnp.ndarray] = None):
+        """Split an existing [in, out] weight (reference copy_params_from)."""
+        in_dim, out_dim = w.shape
+        if out_dim % num_tiles != 0:
+            raise ValueError(f"out_dim {out_dim} not divisible by {num_tiles}")
+        tile = out_dim // num_tiles
+        params = {"w_tiles": jnp.moveaxis(w.reshape(in_dim, num_tiles, tile), 1, 0)}
+        if b is not None:
+            params["b_tiles"] = b.reshape(num_tiles, tile)
+        return params

@@ -2,6 +2,7 @@
 (reference tests/unit/profiling/flops_profiler, test_zero_tensor_fragment.py)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
@@ -73,3 +74,111 @@ def test_env_report_runs(capsys):
     assert main() == 0
     out = capsys.readouterr().out
     assert "dstpu_aio" in out and "flash_attention" in out and "jax backend" in out
+
+
+# -------------------------------------------------------- per-module profiler
+def test_per_module_profile_table():
+    from deepspeed_tpu.profiling.flops_profiler import format_module_table, per_module_profile
+    params = {"attn": {"wq": np.zeros((64, 64))}, "mlp": {"w": np.zeros((64, 256))},
+              "norm": np.zeros((64,))}
+    rows = per_module_profile(params, tokens=128)
+    assert rows[0]["module"] == "mlp.w"          # biggest projection dominates
+    assert rows[0]["flops"] == 2.0 * 128 * 64 * 256
+    assert abs(sum(r["flops_pct"] for r in rows) - 100.0) < 1e-6
+    table = format_module_table(rows, top_k=2)
+    assert "mlp.w" in table and "%" in table
+
+
+# ------------------------------------------------------------ accelerator API
+def test_accelerator_events_streams_and_properties():
+    from deepspeed_tpu.accelerator import get_accelerator
+    acc = get_accelerator()
+    e1, e2 = acc.Event(), acc.Event()
+    e1.record()
+    e2.record()
+    assert acc.Event().__class__ is acc.Event
+    assert e1.elapsed_time(e2) >= 0.0
+    with acc.stream() as s:
+        s.synchronize()
+    props = acc.get_device_properties()
+    assert "platform" in props and props["num_cores"] >= 1
+    # graph capture analog: capture once, replay
+    g = acc.create_graph()
+    out = acc.capture_to_graph(g, lambda x: x * 2, jnp.ones(4))
+    np.testing.assert_array_equal(np.asarray(acc.replay_graph(g, jnp.full(4, 3.0))), 6.0)
+    # pinned memory + rng state
+    buf = acc.pin_memory(np.arange(8))
+    assert acc.is_pinned(buf)
+    key = acc.random_seed(7)
+    state = acc.get_rng_state(key)
+    np.testing.assert_array_equal(np.asarray(acc.set_rng_state(state)), np.asarray(key))
+    # op builder resolution
+    assert acc.get_op_builder("AsyncIOBuilder").__name__ == "AsyncIOBuilder"
+
+
+# ------------------------------------------------------------ sparse gradients
+def test_sparse_tensor_allreduce(mesh8):
+    from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor, embedding_grad_sparse,
+                                                     sparse_all_reduce)
+    from jax.sharding import PartitionSpec
+    vocab, dim = 16, 4
+    embed = jnp.zeros((vocab, dim))
+    # per-rank token ids + grads (8 ranks, 2 tokens each)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, vocab, (16,)))
+    douts = jnp.asarray(np.random.default_rng(1).normal(size=(16, dim)).astype(np.float32))
+
+    def reduce_local(ids_l, dout_l):
+        st = embedding_grad_sparse(embed, ids_l, dout_l)
+        total = sparse_all_reduce(st, "data")
+        return total.to_dense()
+
+    fn = jax.shard_map(reduce_local, mesh=mesh8.mesh,
+                       in_specs=(PartitionSpec("data"), PartitionSpec("data")),
+                       out_specs=PartitionSpec(), check_vma=False)
+    dense = fn(ids, douts)
+    # reference: dense scatter-add of all contributions
+    ref = np.zeros((vocab, dim), np.float32)
+    for i, d in zip(np.asarray(ids), np.asarray(douts)):
+        ref[i] += d
+    np.testing.assert_allclose(np.asarray(dense), ref, atol=1e-5)
+
+
+def test_sparse_tensor_roundtrip():
+    from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+    st = SparseTensor(jnp.asarray([1, 3, 1]), jnp.ones((3, 2)), dense_rows=5)
+    d = np.asarray(st.to_dense())
+    assert d[1].tolist() == [2.0, 2.0] and d[3].tolist() == [1.0, 1.0]
+    assert d[0].sum() == 0
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    st2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert st2.dense_rows == 5
+
+
+# ---------------------------------------------------------------- tiled linear
+def test_tiled_matmul_matches_dense():
+    from deepspeed_tpu.runtime.zero import tiled_matmul
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(32, 64)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(tiled_matmul(x, w, 4)), np.asarray(x @ w),
+                               rtol=1e-5, atol=1e-5)
+    # chunked reduction path: per-tile max == dense blockwise max
+    maxes = tiled_matmul(x, w, 4, reduce_fn=lambda t: t.max())
+    assert maxes.shape == (4,)
+    np.testing.assert_allclose(float(jnp.max(maxes)), float((x @ w).max()), rtol=1e-6)
+
+
+def test_tiled_linear_apply_and_from_dense():
+    from deepspeed_tpu.runtime.zero import TiledLinear
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(32,)).astype(np.float32))
+    params = TiledLinear.from_dense(w, 4, b)
+    x = jnp.asarray(rng.normal(size=(2, 16)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(TiledLinear.apply(params, x)),
+                               np.asarray(x @ w + b), rtol=1e-5, atol=1e-5)
+    init = TiledLinear.init(jax.random.PRNGKey(0), 16, 32, 4)
+    assert init["w_tiles"].shape == (4, 16, 8)
+    # gradient flows through the tiled form
+    g = jax.grad(lambda p: jnp.sum(TiledLinear.apply(p, x) ** 2))(params)
+    assert np.isfinite(np.asarray(g["w_tiles"])).all()
