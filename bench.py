@@ -37,6 +37,58 @@ def detect_peak():
     return PEAK_FLOPS["v5e"]
 
 
+def measure_collective_bw(n_bytes: int = 1 << 28, iters: int = 5):
+    """Allgather bucket bandwidth (BASELINE.json tracked metric).
+
+    Multi-chip: times ``all_gather`` of an evenly sharded fp32 buffer over the
+    data axis and reports busbw = (n-1)/n * bytes / t.  Single chip: no wire to
+    measure, so report achievable HBM copy bandwidth instead (the bound an
+    on-chip gather would hit) under the key ``hbm_bw_gbps``.
+    """
+    import jax
+    import jax.numpy as jnp
+    n_dev = jax.device_count()
+    elems = n_bytes // 4
+    # The iteration loop lives INSIDE one jitted fori_loop: per-call dispatch
+    # (and the axon relay's round-trip) would otherwise dominate; chained
+    # carries keep XLA from eliding the repeats.
+    from jax import lax
+    if n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from deepspeed_tpu.parallel import get_topology
+        mesh = get_topology().mesh
+        axis = mesh.axis_names[0]
+        x = jax.device_put(jnp.ones((elems,), jnp.float32),
+                           NamedSharding(mesh, PartitionSpec(axis)))
+
+        def body(local):
+            g = lax.all_gather(local, axis, tiled=True)
+            return g[:local.shape[0]] * 1.0000001  # depend on the gather
+
+        loop = jax.shard_map(
+            lambda v: lax.fori_loop(0, iters, lambda i, a: body(a), v),
+            mesh=mesh, in_specs=PartitionSpec(axis), out_specs=PartitionSpec(axis),
+            check_vma=False)
+        loop_j = jax.jit(loop)
+        float(loop_j(x)[0])  # compile + settle
+        t0 = time.perf_counter()
+        out = loop_j(x)
+        float(out[0])  # only a value fetch truly syncs on relay transports
+        dt = (time.perf_counter() - t0) / iters
+        busbw = (n_dev - 1) / n_dev * n_bytes / dt
+        return {"allgather_bw_gbps": round(busbw / 1e9, 2),
+                "allgather_bucket_mb": round(n_bytes / 1e6, 1)}
+    x = jnp.ones((elems,), jnp.float32)
+    loop = jax.jit(lambda v: lax.fori_loop(0, iters, lambda i, a: a * 1.0000001, v))
+    float(loop(x)[0])  # compile + settle
+    t0 = time.perf_counter()
+    out = loop(x)
+    float(out[0])
+    dt = (time.perf_counter() - t0) / iters
+    return {"hbm_bw_gbps": round(2 * n_bytes / dt / 1e9, 2),  # read + write
+            "allgather_bucket_mb": round(n_bytes / 1e6, 1)}
+
+
 def main():
     import jax
 
@@ -83,6 +135,8 @@ def main():
     n_chips = jax.device_count()
     flops_per_tok = llama.flops_per_token(cfg, seq)
     mfu = tokens_per_sec * flops_per_tok / (detect_peak() * n_chips)
+    bw = measure_collective_bw(1 << 28 if on_tpu else 1 << 22,
+                               iters=50 if on_tpu else 5)
     print(json.dumps({
         "metric": "llama_zero3_bf16_mfu",
         "value": round(mfu, 4),
@@ -96,6 +150,7 @@ def main():
             "chips": n_chips,
             "zero_stage": 3,
             "vs_ulysses_54pct": round(mfu / 0.54, 4),
+            **bw,
         },
     }))
 

@@ -73,25 +73,38 @@ def per_module_profile(params: Any, tokens: int, top_k: int = 0):
 
     The reference counts MACs analytically per nn.Module via forward hooks;
     functional pytrees have no modules, so the unit of attribution is the
-    param subtree: every >=2D leaf is a projection applied once per token
-    (2 * tokens * nelem MACs->FLOPs, matmul dominance), 1D leaves are
-    elementwise.  Scan-stacked leaves [L, ...] count all L applications.
-    Returns rows [{'module', 'params', 'flops', 'flops_pct'}] sorted by
-    flops desc (all rows, or ``top_k``).
+    param subtree.  Classification is shape + NAME based (the name stands in
+    for the reference's module type): leaves matching norm/bias/scale/ln are
+    elementwise regardless of stacking (a scan-stacked norm is [L, D], not a
+    projection); ``embed``-named tables are lookups (gather, ~copy cost);
+    every other >=2D leaf is a projection applied once per token
+    (2 * tokens * nelem MACs->FLOPs).  Scan-stacked projections [L, in, out]
+    count all L applications.  Returns rows [{'module', 'params', 'flops',
+    'flops_pct'}] sorted by flops desc (all rows, or ``top_k``).
     """
+    import re as _re
     flat, _ = jax.tree_util.tree_flatten_with_path(params)
 
     def key_of(path):
         return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
+    elementwise_pat = _re.compile(r"(?:^|[._])(?:\w*norm\w*|bias|b|scale|ln\w*|g)(?:$|[._])")
+    lookup_pat = _re.compile(r"(?:^|[._])(?:embed\w*|wte|wpe|tok\w*)(?:$|[._])")
+
     rows = []
     for path, leaf in flat:
+        key = key_of(path)
         n = int(np.size(leaf))
-        if np.ndim(leaf) >= 2:
-            flops = 2.0 * tokens * n       # one matmul pass per token
+        if elementwise_pat.search(key) or np.ndim(leaf) < 2:
+            # norms/biases (possibly layer-stacked): one multiply-add per
+            # element of the trailing feature dim per token
+            feat = int(np.shape(leaf)[-1]) if np.ndim(leaf) >= 1 else 1
+            flops = float(tokens * max(feat, 1))
+        elif lookup_pat.search(key):
+            flops = float(tokens * int(np.shape(leaf)[-1]))  # gather copy
         else:
-            flops = float(tokens * max(n, 1))  # elementwise (norms, biases)
-        rows.append({"module": key_of(path), "params": n, "flops": flops})
+            flops = 2.0 * tokens * n       # one matmul pass per token
+        rows.append({"module": key, "params": n, "flops": flops})
     total = sum(r["flops"] for r in rows) or 1.0
     for r in rows:
         r["flops_pct"] = 100.0 * r["flops"] / total

@@ -11,7 +11,10 @@ and the universal-checkpoint converter for this; here reshape-on-load is the
 native behavior, and the universal format in deepspeed_tpu/checkpoint/ adds
 tp/pp-aware merging on top).
 
-Large leaves are gathered to host one at a time to bound peak host memory.
+Sharded leaves stream device shard -> memmap'd .npy directly (host RAM peaks
+at one SHARD, not one leaf); loads mmap the file so each target shard reads
+only its pages.  The rank-0 full-gather spike the reference's universal
+checkpoint works around never happens.
 """
 
 import json
@@ -62,10 +65,15 @@ def save_checkpoint_dir(save_dir: str, tag: str, state, client_state: Dict, conf
     manifest = []
     for path, leaf in leaves_with_path:
         key = _leaf_key(path)
-        arr = _gather_to_host(leaf)
-        if _is_rank0():
-            engine.save(arr, os.path.join(ckpt_dir, key + ".npy"))
-        manifest.append({"key": key, "shape": list(arr.shape), "dtype": str(arr.dtype)})
+        target = os.path.join(ckpt_dir, key + ".npy")
+        if _is_rank0() and _write_leaf_streaming(leaf, target, engine):
+            pass  # shard-streamed straight into the .npy (no full-leaf host copy)
+        else:
+            arr = _gather_to_host(leaf)
+            if _is_rank0():
+                engine.save(arr, target)
+        dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+        manifest.append({"key": key, "shape": list(np.shape(leaf)), "dtype": str(dtype)})
     engine.commit(tag)
     if _is_rank0():
         meta = {"manifest": manifest, "client_state": _jsonable(client_state)}
@@ -81,6 +89,41 @@ def _gather_to_host(leaf) -> np.ndarray:
         rep = NamedSharding(leaf.sharding.mesh, PartitionSpec())
         leaf = jax.device_put(leaf, rep)
     return np.asarray(leaf)
+
+
+def _write_leaf_streaming(leaf, target: str, engine) -> bool:
+    """Stream a sharded leaf's device shards straight into one ``.npy`` via a
+    memmap — host RAM stays at one SHARD, not one leaf (the reference's
+    universal checkpoint exists to avoid exactly this rank-0 gather spike;
+    here the per-shard write is the fix at the source).  Returns False when
+    the leaf isn't a multi-device jax.Array or the engine isn't file-backed
+    (fallback: gather + engine.save)."""
+    if not isinstance(leaf, jax.Array) or len(leaf.sharding.device_set) <= 1:
+        return False
+    if not leaf.is_fully_addressable:
+        # multi-host: this process can't see every shard — writing only local
+        # shards would persist zeros for the rest, and skipping the gather on
+        # rank 0 while others enter it would desync the collective.  All ranks
+        # take the gather path together.
+        return False
+    if not isinstance(engine, NativeCheckpointEngine):
+        return False  # plug-in engines define their own persistence
+    try:
+        out = np.lib.format.open_memmap(target, mode="w+", dtype=np.dtype(leaf.dtype),
+                                        shape=leaf.shape)
+        seen = set()
+        for shard in leaf.addressable_shards:
+            if shard.index in seen:  # replicated-over-axis shards write once
+                continue
+            seen.add(shard.index)
+            out[shard.index] = np.asarray(shard.data)
+        out.flush()
+        del out
+        return True
+    except Exception as exc:  # exotic dtype/fs: fall back to the gather path
+        logger.warning(f"streaming shard write failed for {target} ({exc}); "
+                       f"falling back to gathered save")
+        return False
 
 
 def _jsonable(obj):
@@ -134,11 +177,15 @@ def load_checkpoint_dir(load_dir: str,
                 logger.warning(f"checkpoint missing leaf {key}; keeping current value")
             new_leaves.append(cur_leaf)
             continue
-        arr = np.load(os.path.join(ckpt_dir, key + ".npy"))
+        # mmap: device_put below slices per target shard, so only the pages a
+        # shard needs are ever read into host RAM
+        arr = np.load(os.path.join(ckpt_dir, key + ".npy"), mmap_mode="r")
         expected = tuple(np.shape(cur_leaf))
         if tuple(arr.shape) != expected:
             raise ValueError(f"checkpoint leaf {key} shape {arr.shape} != model shape {expected}")
-        arr = arr.astype(np.asarray(cur_leaf).dtype) if hasattr(cur_leaf, "dtype") else arr
+        want = getattr(cur_leaf, "dtype", None)
+        if want is not None and arr.dtype != want:
+            arr = arr.astype(want)  # materializes; same-dtype mmap stays lazy
         new_leaves.append(jax.device_put(arr, sharding))
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])

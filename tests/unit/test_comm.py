@@ -142,3 +142,29 @@ def test_collective_bandwidth_microbench(mesh8):
                         topology=mesh8, iters=1)
     assert [r["op"] for r in results] == ["all_reduce", "reduce_scatter"]
     assert all(x["time_ms"] > 0 for x in results)
+
+
+def test_allgather_bandwidth_microbench(mesh8):
+    """Bandwidth measurement machinery (BASELINE.json allgather bucket
+    bandwidth): busbw formula over a timed sharded->replicated gather.
+    Numbers are meaningless on the CPU mesh; shape/finiteness are the test."""
+    import time
+    from jax.sharding import NamedSharding, PartitionSpec
+    n_bytes = 1 << 16
+    elems = n_bytes // 4
+    x = jax.device_put(jnp.ones((elems,), jnp.float32),
+                       NamedSharding(mesh8.mesh, PartitionSpec("data")))
+    gather = jax.jit(lambda v: v + 0.0,
+                     out_shardings=NamedSharding(mesh8.mesh, PartitionSpec()))
+    gather(x).block_until_ready()
+    t0 = time.perf_counter()
+    out = gather(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    n = 8
+    busbw = (n - 1) / n * n_bytes / dt
+    assert np.isfinite(busbw) and busbw > 0
+    assert out.shape == (elems,)
+    from jax.sharding import PartitionSpec as PSpec
+    assert out.sharding.spec == PSpec()  # fully replicated after the gather
+    np.testing.assert_array_equal(np.asarray(out[:4]), 1.0)

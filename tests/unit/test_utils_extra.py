@@ -182,3 +182,15 @@ def test_tiled_linear_apply_and_from_dense():
     # gradient flows through the tiled form
     g = jax.grad(lambda p: jnp.sum(TiledLinear.apply(p, x) ** 2))(params)
     assert np.isfinite(np.asarray(g["w_tiles"])).all()
+
+
+def test_per_module_profile_classification():
+    """Stacked norms are elementwise, embeds are lookups, not matmuls."""
+    from deepspeed_tpu.profiling.flops_profiler import per_module_profile
+    params = {"layers": {"attn_norm": np.zeros((4, 64)),       # [L, D] stacked norm
+                         "wq": np.zeros((4, 64, 64))},         # [L, in, out] stacked proj
+              "embed": np.zeros((1000, 64))}
+    rows = {r["module"]: r for r in per_module_profile(params, tokens=100)}
+    assert rows["layers.attn_norm"]["flops"] == 100 * 64          # elementwise
+    assert rows["embed"]["flops"] == 100 * 64                     # lookup copy
+    assert rows["layers.wq"]["flops"] == 2.0 * 100 * 4 * 64 * 64  # all L matmuls
