@@ -49,35 +49,15 @@ def measure_collective_bw(n_bytes: int = 1 << 28, iters: int = 5):
     import jax.numpy as jnp
     n_dev = jax.device_count()
     elems = n_bytes // 4
-    # The iteration loop lives INSIDE one jitted fori_loop: per-call dispatch
-    # (and the axon relay's round-trip) would otherwise dominate; chained
-    # carries keep XLA from eliding the repeats.
+    # Multi-chip: the canonical implementation lives in comm/benchmark.py
+    # (the ds_bench analog); compiled_loop keeps relay dispatch out of dt.
     from jax import lax
     if n_dev > 1:
-        from jax.sharding import NamedSharding, PartitionSpec
-        from deepspeed_tpu.parallel import get_topology
-        mesh = get_topology().mesh
-        axis = mesh.axis_names[0]
-        x = jax.device_put(jnp.ones((elems,), jnp.float32),
-                           NamedSharding(mesh, PartitionSpec(axis)))
-
-        def body(local):
-            g = lax.all_gather(local, axis, tiled=True)
-            return g[:local.shape[0]] * 1.0000001  # depend on the gather
-
-        loop = jax.shard_map(
-            lambda v: lax.fori_loop(0, iters, lambda i, a: body(a), v),
-            mesh=mesh, in_specs=PartitionSpec(axis), out_specs=PartitionSpec(axis),
-            check_vma=False)
-        loop_j = jax.jit(loop)
-        float(loop_j(x)[0])  # compile + settle
-        t0 = time.perf_counter()
-        out = loop_j(x)
-        float(out[0])  # only a value fetch truly syncs on relay transports
-        dt = (time.perf_counter() - t0) / iters
-        busbw = (n_dev - 1) / n_dev * n_bytes / dt
-        return {"allgather_bw_gbps": round(busbw / 1e9, 2),
-                "allgather_bucket_mb": round(n_bytes / 1e6, 1)}
+        from deepspeed_tpu.comm.benchmark import collective_bandwidth
+        res = collective_bandwidth("all_gather", elems=elems, dtype=jnp.float32,
+                                   iters=iters, compiled_loop=True)
+        return {"allgather_bw_gbps": round(res["busbw_gbps"], 2),
+                "allgather_bucket_mb": round(res["bytes"] / 1e6, 1)}
     x = jnp.ones((elems,), jnp.float32)
     loop = jax.jit(lambda v: lax.fori_loop(0, iters, lambda i, a: a * 1.0000001, v))
     float(loop(x)[0])  # compile + settle

@@ -46,12 +46,17 @@ def collective_bandwidth(op: str = "all_gather",
                          dtype=jnp.bfloat16,
                          axis: str = "data",
                          topology: Optional[MeshTopology] = None,
-                         iters: int = 10) -> Dict[str, float]:
+                         iters: int = 10,
+                         compiled_loop: bool = False) -> Dict[str, float]:
     """Measure one collective's bandwidth over a mesh axis.
 
     ``elems`` is the GLOBAL bucket element count (default = the reference's
     5e8-element allgather bucket in bf16 bytes).  Returns {time_ms, algbw_gbps,
     busbw_gbps, world, bytes}.
+
+    ``compiled_loop`` runs all ``iters`` inside ONE jitted fori_loop with a
+    chained carry — use it on relay transports (axon), where per-call dispatch
+    round-trips would otherwise dominate the timing.
     """
     topo = topology or get_topology()
     world = topo.axis_size(axis)
@@ -80,12 +85,33 @@ def collective_bandwidth(op: str = "all_gather",
     else:
         raise ValueError(f"unknown op {op!r}")
 
-    shard_fn = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                      check_vma=False))
-    x = jax.device_put(jnp.zeros((elems,), dtype),
-                       NamedSharding(mesh, in_spec))
-    dt = _time_op(shard_fn, x, iters)
+    if compiled_loop:
+        # the whole iteration loop in one program: the per-shard input is fed
+        # through the collective, and a slice of each result perturbs the next
+        # input so XLA cannot elide the repeats
+        from jax import lax
+
+        def looped(x):
+            def step(i, acc):
+                out = body(acc)
+                return acc + out.ravel()[0] * 0.0  # depend on this iteration
+            return lax.fori_loop(0, iters, step, x)
+
+        shard_fn = jax.jit(
+            jax.shard_map(looped, mesh=mesh, in_specs=in_spec, out_specs=in_spec,
+                          check_vma=False))
+        x = jax.device_put(jnp.zeros((elems,), dtype), NamedSharding(mesh, in_spec))
+        _sync(shard_fn(x))  # compile + settle
+        t0 = time.perf_counter()
+        _sync(shard_fn(x))
+        dt = (time.perf_counter() - t0) / iters
+    else:
+        shard_fn = jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                          check_vma=False))
+        x = jax.device_put(jnp.zeros((elems,), dtype),
+                           NamedSharding(mesh, in_spec))
+        dt = _time_op(shard_fn, x, iters)
     nbytes = elems * itemsize
     algbw = nbytes / dt / 1e9
     return {

@@ -1,0 +1,156 @@
+"""Falcon causal LM (tiiuae/falcon family).
+
+Parity: reference inference/v2/model_implementations/falcon.  Architecture vs
+Llama: PARALLEL attention+MLP off one shared input LayerNorm
+(x + attn(ln(x)) + mlp(ln(x))), multi-query attention (1 KV head on 7B; GQA
+on 40B), rotary embeddings, GELU 4x MLP, no projection biases, tied unembed.
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (apply_rotary, cross_entropy_loss, layer_norm,
+                          paged_chunk_indices, rotary_tables, sdpa)
+
+
+@dataclasses.dataclass(frozen=True)
+class FalconConfig:
+    vocab_size: int = 65024
+    hidden_size: int = 4544
+    num_layers: int = 32
+    num_heads: int = 71
+    num_kv_heads: int = 1          # MQA on falcon-7b
+    max_seq_len: int = 2048
+    ln_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    remat: bool = True
+
+    @staticmethod
+    def falcon_7b():
+        return FalconConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=1, seq=64):
+        return FalconConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                            num_heads=heads, num_kv_heads=kv_heads, max_seq_len=seq)
+
+
+def init_params(config: FalconConfig, key, dtype=jnp.float32):
+    D, L, H, KV = config.hidden_size, config.num_layers, config.num_heads, config.num_kv_heads
+    Dh = D // H
+    ks = jax.random.split(key, 7)
+    s = D ** -0.5
+
+    def stack(k, shape):
+        return jax.random.normal(k, (L, *shape), dtype) * s
+
+    return {
+        "embed": jax.random.normal(ks[0], (config.vocab_size, D), dtype) * 0.02,
+        "layers": {
+            "ln_w": jnp.ones((L, D), dtype), "ln_b": jnp.zeros((L, D), dtype),
+            "wq": stack(ks[1], (D, H * Dh)), "wk": stack(ks[2], (D, KV * Dh)),
+            "wv": stack(ks[3], (D, KV * Dh)), "wo": stack(ks[4], (H * Dh, D)),
+            "fc1": stack(ks[5], (D, 4 * D)), "fc2": stack(ks[6], (4 * D, D)),
+        },
+        "final_ln_w": jnp.ones((D,), dtype), "final_ln_b": jnp.zeros((D,), dtype),
+    }
+
+
+def num_params(config: FalconConfig) -> int:
+    return sum(int(np.prod(np.shape(l)))
+               for l in jax.tree_util.tree_leaves(
+                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+
+
+def _block(config: FalconConfig, lp, x, cos, sin, attention_fn=None):
+    b, s, D = x.shape
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = D // H
+    h = layer_norm(x, lp["ln_w"], lp["ln_b"], config.ln_eps)
+    q = (h @ lp["wq"].astype(x.dtype)).reshape(b, s, H, Dh)
+    k = (h @ lp["wk"].astype(x.dtype)).reshape(b, s, KV, Dh)
+    v = (h @ lp["wv"].astype(x.dtype)).reshape(b, s, KV, Dh)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    attn = (attention_fn or sdpa)(q, k, v, causal=True)
+    attn_out = attn.reshape(b, s, H * Dh) @ lp["wo"].astype(x.dtype)
+    mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype), approximate=True) @ lp["fc2"].astype(x.dtype)
+    return x + attn_out + mlp_out  # parallel residual
+
+
+def forward(config: FalconConfig, params, input_ids, attention_fn=None):
+    Dh = config.hidden_size // config.num_heads
+    cos, sin = rotary_tables(Dh, config.max_seq_len, config.rope_theta)
+    x = params["embed"][input_ids]
+
+    def body(h, lp):
+        return _block(config, lp, h, cos, sin, attention_fn), None
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    return x @ params["embed"].T.astype(x.dtype)
+
+
+def make_loss_fn(config: FalconConfig, attention_fn=None) -> Callable:
+    def loss_fn(params, batch, rng=None):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+    return loss_fn
+
+
+def causal_lm_batch(ids):
+    ids = np.asarray(ids)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+# --------------------------------------------------------- paged (ragged) serve
+def init_paged_cache(config: FalconConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    L, KV = config.num_layers, config.num_kv_heads
+    Dh = config.hidden_size // config.num_heads
+    return {"k": jnp.zeros((L, num_blocks, KV, block_size, Dh), dtype),
+            "v": jnp.zeros((L, num_blocks, KV, block_size, Dh), dtype)}
+
+
+def forward_paged(config: FalconConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked Falcon forward — MQA KV pool (1 KV head) through the
+    Pallas paged kernel's GQA head mapping."""
+    from ..ops.attention.paged import paged_attention
+
+    b, tchunk = tokens.shape
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+    cos, sin = rotary_tables(Dh, config.max_seq_len, config.rope_theta)
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    head_idx = jnp.arange(KV)[None, None, :]
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        h = layer_norm(x, lp["ln_w"], lp["ln_b"], config.ln_eps)
+        q = (h @ lp["wq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (h @ lp["wk"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        v = (h @ lp["wv"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        q = apply_rotary(q, cos, sin, safe_pos)
+        k = apply_rotary(k, cos, sin, safe_pos)
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale)
+        attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)
+        mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype),
+                              approximate=True) @ lp["fc2"].astype(x.dtype)
+        return x + attn_out + mlp_out, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    logits = x @ params["embed"].T.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

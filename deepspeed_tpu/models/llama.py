@@ -15,8 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import (apply_rotary, attention_block, cross_entropy_loss, init_linear, rms_norm, rotary_tables,
-                          sdpa, swiglu_mlp)
+from .transformer import (apply_rotary, attention_block, cross_entropy_loss, init_linear,
+                          paged_chunk_indices, rms_norm, rotary_tables, sdpa, swiglu_mlp)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -371,20 +371,13 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
     from ..ops.attention.paged import paged_attention
 
     b, tchunk = tokens.shape
-    trash = kv_cache["k"].shape[1] - 1
     cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
-    positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]  # [N, T]
-    valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
-    safe_pos = jnp.where(valid, positions, 0)
-    lengths = start_pos + n_tokens
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     H, KV = config.num_heads, config.num_kv_heads
     Dh = config.hidden_size // H
     scale = 1.0 / np.sqrt(Dh)
-
-    blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
-    blk = jnp.where(valid, blk, trash)
-    off = jnp.where(valid, safe_pos % block_size, 0)
     head_idx = jnp.arange(KV)[None, None, :]
 
     def layer(x, inp):

@@ -17,7 +17,8 @@ import numpy as np
 from ..moe.experts import init_swiglu_experts, swiglu_experts
 from ..moe.sharded_moe import TopKGate, moe_layer
 from ..parallel.mesh import EXPERT_AXIS
-from .transformer import attention_block, cross_entropy_loss, init_linear, rms_norm, rotary_tables
+from .transformer import (attention_block, cross_entropy_loss, init_linear,
+                          paged_chunk_indices, rms_norm, rotary_tables)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -212,20 +213,14 @@ def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, bl
     from .transformer import apply_rotary
 
     b, tchunk = tokens.shape
-    trash = kv_cache["k"].shape[1] - 1
     cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len,
                              config.rope_theta)
-    positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]
-    valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
-    safe_pos = jnp.where(valid, positions, 0)
-    lengths = start_pos + n_tokens
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     H, KV = config.num_heads, config.num_kv_heads
     Dh = config.hidden_size // H
     scale = 1.0 / np.sqrt(Dh)
-    blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
-    blk = jnp.where(valid, blk, trash)
-    off = jnp.where(valid, safe_pos % block_size, 0)
     head_idx = jnp.arange(KV)[None, None, :]
 
     def layer(x, inp):

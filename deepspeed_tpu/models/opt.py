@@ -1,0 +1,162 @@
+"""OPT causal LM (facebook/opt family).
+
+Parity: reference inference/v2/model_implementations/opt (container + policy
+serving OPT with blocked flash).  Architecture vs Llama: learned positional
+embeddings (with OPT's +2 offset quirk), pre-LayerNorm blocks with biases,
+standard MHA (no GQA), ReLU fc1/fc2 MLP, tied unembedding.
+
+Training forward is a scan over stacked layers (ZeRO-3-friendly like
+models/llama.py); ``forward_paged`` serves ragged batches through the Pallas
+paged kernel (ops/attention/paged.py).
+"""
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import cross_entropy_loss, layer_norm, paged_chunk_indices, sdpa
+
+POS_OFFSET = 2  # OPT reserves the first two position slots (HF modeling_opt)
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 2048
+    ln_eps: float = 1e-5
+    remat: bool = True
+
+    @staticmethod
+    def opt_125m():
+        return OPTConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, seq=64):
+        return OPTConfig(vocab_size=vocab, hidden_size=hidden, ffn_dim=hidden * 4,
+                         num_layers=layers, num_heads=heads, max_seq_len=seq)
+
+
+def init_params(config: OPTConfig, key, dtype=jnp.float32):
+    D, F, L = config.hidden_size, config.ffn_dim, config.num_layers
+    ks = jax.random.split(key, 8)
+    s = D ** -0.5
+
+    def stack(k, shape):
+        return jax.random.normal(k, (L, *shape), dtype) * s
+
+    return {
+        "embed": jax.random.normal(ks[0], (config.vocab_size, D), dtype) * 0.02,
+        "pos_embed": jax.random.normal(ks[1], (config.max_seq_len + POS_OFFSET, D), dtype) * 0.02,
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dtype), "ln1_b": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), dtype), "ln2_b": jnp.zeros((L, D), dtype),
+            "wq": stack(ks[2], (D, D)), "wk": stack(ks[3], (D, D)),
+            "wv": stack(ks[4], (D, D)), "wo": stack(ks[5], (D, D)),
+            "bq": jnp.zeros((L, D), dtype), "bk": jnp.zeros((L, D), dtype),
+            "bv": jnp.zeros((L, D), dtype), "bo": jnp.zeros((L, D), dtype),
+            "fc1": stack(ks[6], (D, F)), "b_fc1": jnp.zeros((L, F), dtype),
+            "fc2": stack(ks[7], (F, D)), "b_fc2": jnp.zeros((L, D), dtype),
+        },
+        "final_ln_w": jnp.ones((D,), dtype), "final_ln_b": jnp.zeros((D,), dtype),
+    }
+
+
+def num_params(config: OPTConfig) -> int:
+    return sum(int(np.prod(np.shape(l)))
+               for l in jax.tree_util.tree_leaves(
+                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+
+
+def _block(config: OPTConfig, lp, x, attention_fn=None):
+    b, s, D = x.shape
+    H = config.num_heads
+    Dh = D // H
+    h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], config.ln_eps)
+    q = (h @ lp["wq"].astype(x.dtype) + lp["bq"].astype(x.dtype)).reshape(b, s, H, Dh)
+    k = (h @ lp["wk"].astype(x.dtype) + lp["bk"].astype(x.dtype)).reshape(b, s, H, Dh)
+    v = (h @ lp["wv"].astype(x.dtype) + lp["bv"].astype(x.dtype)).reshape(b, s, H, Dh)
+    attn = (attention_fn or sdpa)(q, k, v, causal=True)
+    x = x + attn.reshape(b, s, D) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+    h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], config.ln_eps)
+    h = jax.nn.relu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype))
+    return x + h @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+
+
+def forward(config: OPTConfig, params, input_ids, attention_fn=None):
+    s = input_ids.shape[1]
+    x = params["embed"][input_ids]
+    x = x + params["pos_embed"][POS_OFFSET:POS_OFFSET + s][None].astype(x.dtype)
+
+    def body(h, lp):
+        return _block(config, lp, h, attention_fn), None
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    return x @ params["embed"].T.astype(x.dtype)  # tied unembed
+
+
+def make_loss_fn(config: OPTConfig, attention_fn=None) -> Callable:
+    def loss_fn(params, batch, rng=None):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+    return loss_fn
+
+
+def causal_lm_batch(ids):
+    ids = np.asarray(ids)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+# --------------------------------------------------------- paged (ragged) serve
+def init_paged_cache(config: OPTConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    L, H = config.num_layers, config.num_heads
+    Dh = config.hidden_size // H
+    return {"k": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype),
+            "v": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype)}
+
+
+def forward_paged(config: OPTConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked OPT forward (learned positions — no rotary on K/Q)."""
+    from ..ops.attention.paged import paged_attention
+
+    b, tchunk = tokens.shape
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
+    H = config.num_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    x = x + params["pos_embed"][safe_pos + POS_OFFSET].astype(x.dtype)
+    head_idx = jnp.arange(H)[None, None, :]
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], config.ln_eps)
+        q = (h @ lp["wq"].astype(x.dtype) + lp["bq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (h @ lp["wk"].astype(x.dtype) + lp["bk"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        v = (h @ lp["wv"].astype(x.dtype) + lp["bv"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale)
+        x = x + out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], config.ln_eps)
+        h = jax.nn.relu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype))
+        x = x + h @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+        return x, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    logits = x @ params["embed"].T.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

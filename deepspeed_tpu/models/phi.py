@@ -1,0 +1,176 @@
+"""Phi causal LM (microsoft/phi-2 family).
+
+Parity: reference inference/v2/model_implementations/phi.  Architecture:
+parallel attention+MLP like Falcon but with biases everywhere, PARTIAL rotary
+(only the first ``rotary_dim`` of each head rotates — phi-2's
+partial_rotary_factor 0.4), GELU fc1/fc2 MLP, untied lm_head with bias.
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (apply_rotary, cross_entropy_loss, layer_norm,
+                          paged_chunk_indices, rotary_tables, sdpa)
+
+
+@dataclasses.dataclass(frozen=True)
+class PhiConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 2560
+    ffn_dim: int = 10240
+    num_layers: int = 32
+    num_heads: int = 32
+    max_seq_len: int = 2048
+    partial_rotary_factor: float = 0.4
+    ln_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    remat: bool = True
+
+    @property
+    def rotary_dim(self) -> int:
+        dh = self.hidden_size // self.num_heads
+        # HF phi rounds the rotary slice to an even size
+        return int(dh * self.partial_rotary_factor) // 2 * 2
+
+    @staticmethod
+    def phi_2():
+        return PhiConfig()
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, seq=64):
+        return PhiConfig(vocab_size=vocab, hidden_size=hidden, ffn_dim=hidden * 4,
+                         num_layers=layers, num_heads=heads, max_seq_len=seq,
+                         partial_rotary_factor=0.5)
+
+
+def partial_rotary(x, cos, sin, rotary_dim: int, positions=None):
+    """Rotate only the leading ``rotary_dim`` of the head dim; rest passes."""
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    rot = apply_rotary(rot, cos, sin, positions)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+def init_params(config: PhiConfig, key, dtype=jnp.float32):
+    D, F, L = config.hidden_size, config.ffn_dim, config.num_layers
+    ks = jax.random.split(key, 8)
+    s = D ** -0.5
+
+    def stack(k, shape):
+        return jax.random.normal(k, (L, *shape), dtype) * s
+
+    return {
+        "embed": jax.random.normal(ks[0], (config.vocab_size, D), dtype) * 0.02,
+        "layers": {
+            "ln_w": jnp.ones((L, D), dtype), "ln_b": jnp.zeros((L, D), dtype),
+            "wq": stack(ks[1], (D, D)), "bq": jnp.zeros((L, D), dtype),
+            "wk": stack(ks[2], (D, D)), "bk": jnp.zeros((L, D), dtype),
+            "wv": stack(ks[3], (D, D)), "bv": jnp.zeros((L, D), dtype),
+            "wo": stack(ks[4], (D, D)), "bo": jnp.zeros((L, D), dtype),
+            "fc1": stack(ks[5], (D, F)), "b_fc1": jnp.zeros((L, F), dtype),
+            "fc2": stack(ks[6], (F, D)), "b_fc2": jnp.zeros((L, D), dtype),
+        },
+        "final_ln_w": jnp.ones((D,), dtype), "final_ln_b": jnp.zeros((D,), dtype),
+        "lm_head": jax.random.normal(ks[7], (D, config.vocab_size), dtype) * s,
+        "lm_head_b": jnp.zeros((config.vocab_size,), dtype),
+    }
+
+
+def num_params(config: PhiConfig) -> int:
+    return sum(int(np.prod(np.shape(l)))
+               for l in jax.tree_util.tree_leaves(
+                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+
+
+def _block(config: PhiConfig, lp, x, cos, sin, attention_fn=None):
+    b, s, D = x.shape
+    H = config.num_heads
+    Dh = D // H
+    h = layer_norm(x, lp["ln_w"], lp["ln_b"], config.ln_eps)
+    q = (h @ lp["wq"].astype(x.dtype) + lp["bq"].astype(x.dtype)).reshape(b, s, H, Dh)
+    k = (h @ lp["wk"].astype(x.dtype) + lp["bk"].astype(x.dtype)).reshape(b, s, H, Dh)
+    v = (h @ lp["wv"].astype(x.dtype) + lp["bv"].astype(x.dtype)).reshape(b, s, H, Dh)
+    q = partial_rotary(q, cos, sin, config.rotary_dim)
+    k = partial_rotary(k, cos, sin, config.rotary_dim)
+    attn = (attention_fn or sdpa)(q, k, v, causal=True)
+    attn_out = attn.reshape(b, s, D) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+    mlp = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype),
+                      approximate=True)
+    mlp_out = mlp @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+    return x + attn_out + mlp_out  # parallel residual
+
+
+def forward(config: PhiConfig, params, input_ids, attention_fn=None):
+    cos, sin = rotary_tables(config.rotary_dim, config.max_seq_len, config.rope_theta)
+    x = params["embed"][input_ids]
+
+    def body(h, lp):
+        return _block(config, lp, h, cos, sin, attention_fn), None
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    return x @ params["lm_head"].astype(x.dtype) + params["lm_head_b"].astype(x.dtype)
+
+
+def make_loss_fn(config: PhiConfig, attention_fn=None) -> Callable:
+    def loss_fn(params, batch, rng=None):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+    return loss_fn
+
+
+def causal_lm_batch(ids):
+    ids = np.asarray(ids)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+# --------------------------------------------------------- paged (ragged) serve
+def init_paged_cache(config: PhiConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    L, H = config.num_layers, config.num_heads
+    Dh = config.hidden_size // H
+    return {"k": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype),
+            "v": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype)}
+
+
+def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked Phi forward — partial rotary feeds the paged kernel."""
+    from ..ops.attention.paged import paged_attention
+
+    b, tchunk = tokens.shape
+    H = config.num_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+    cos, sin = rotary_tables(config.rotary_dim, config.max_seq_len, config.rope_theta)
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    head_idx = jnp.arange(H)[None, None, :]
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        h = layer_norm(x, lp["ln_w"], lp["ln_b"], config.ln_eps)
+        q = (h @ lp["wq"].astype(x.dtype) + lp["bq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (h @ lp["wk"].astype(x.dtype) + lp["bk"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        v = (h @ lp["wv"].astype(x.dtype) + lp["bv"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        q = partial_rotary(q, cos, sin, config.rotary_dim, safe_pos)
+        k = partial_rotary(k, cos, sin, config.rotary_dim, safe_pos)
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale)
+        attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+        mlp = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype),
+                          approximate=True)
+        mlp_out = mlp @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+        return x + attn_out + mlp_out, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    logits = x @ params["lm_head"].astype(x.dtype) + params["lm_head_b"].astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

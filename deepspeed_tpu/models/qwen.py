@@ -1,0 +1,145 @@
+"""Qwen2 causal LM (Qwen/Qwen2 family).
+
+Parity: reference inference/v2/model_implementations/qwen.  Qwen2 is the
+Llama architecture with BIASES on the Q/K/V projections (output projection
+and MLP stay bias-free) — so everything delegates to models/llama with the
+bias terms folded in by pre-adding them through a wrapped forward.
+
+Implementation note: rather than forking llama's scan, the qkv biases are
+threaded as extra per-layer params and applied via a custom block that calls
+the same building blocks (transformer.attention_block has no bias slot, so
+the block is written out here; the paged path mirrors llama.forward_paged
+with the three bias adds).
+"""
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama
+from .llama import LlamaConfig
+from .transformer import (apply_rotary, cross_entropy_loss, paged_chunk_indices,
+                          rms_norm, rotary_tables, sdpa, swiglu_mlp)
+
+
+@dataclasses.dataclass(frozen=True)
+class QwenConfig(LlamaConfig):
+
+    @staticmethod
+    def qwen2_7b():
+        return QwenConfig(vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+                          num_layers=28, num_heads=28, num_kv_heads=4,
+                          max_seq_len=32768, rope_theta=1000000.0)
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=2, seq=64):
+        return QwenConfig(vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+                          num_layers=layers, num_heads=heads, num_kv_heads=kv_heads,
+                          max_seq_len=seq)
+
+
+def init_params(config: QwenConfig, key, dtype=jnp.float32):
+    """Llama params + per-layer q/k/v biases."""
+    params = llama.init_params(config, key, dtype)
+    L = config.num_layers
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    params["layers"]["attn"]["bq"] = jnp.zeros((L, H * Dh), dtype)
+    params["layers"]["attn"]["bk"] = jnp.zeros((L, KV * Dh), dtype)
+    params["layers"]["attn"]["bv"] = jnp.zeros((L, KV * Dh), dtype)
+    return params
+
+
+def num_params(config: QwenConfig) -> int:
+    return sum(int(np.prod(np.shape(l)))
+               for l in jax.tree_util.tree_leaves(
+                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+
+
+def _block(config: QwenConfig, lp, x, cos, sin, attention_fn=None):
+    b, s, D = x.shape
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = D // H
+    a = lp["attn"]
+    attn_in = rms_norm(x, lp["attn_norm"], config.rms_eps)
+    q = (attn_in @ a["wq"].astype(x.dtype) + a["bq"].astype(x.dtype)).reshape(b, s, H, Dh)
+    k = (attn_in @ a["wk"].astype(x.dtype) + a["bk"].astype(x.dtype)).reshape(b, s, KV, Dh)
+    v = (attn_in @ a["wv"].astype(x.dtype) + a["bv"].astype(x.dtype)).reshape(b, s, KV, Dh)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    out = (attention_fn or sdpa)(q, k, v, causal=True)
+    x = x + out.reshape(b, s, H * Dh) @ a["wo"].astype(x.dtype)
+    mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+    return x + swiglu_mlp(lp["mlp"], mlp_in)
+
+
+def forward(config: QwenConfig, params, input_ids, attention_fn=None):
+    Dh = config.hidden_size // config.num_heads
+    cos, sin = rotary_tables(Dh, config.max_seq_len, config.rope_theta)
+    x = params["embed"][input_ids]
+
+    def body(h, lp):
+        return _block(config, lp, h, cos, sin, attention_fn), None
+
+    if config.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    return x @ head.astype(x.dtype)
+
+
+def make_loss_fn(config: QwenConfig, attention_fn=None) -> Callable:
+    def loss_fn(params, batch, rng=None):
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        return cross_entropy_loss(logits, batch["labels"])
+    return loss_fn
+
+
+causal_lm_batch = llama.causal_lm_batch
+init_paged_cache = llama.init_paged_cache
+tp_rules = llama.tp_rules
+
+
+def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int):
+    """Ragged chunked Qwen2 forward: llama's paged layer + qkv bias adds."""
+    from ..ops.attention.paged import paged_attention
+
+    b, tchunk = tokens.shape
+    cos, sin = rotary_tables(config.hidden_size // config.num_heads,
+                             config.max_seq_len, config.rope_theta)
+    safe_pos, valid, lengths, blk, off = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    scale = 1.0 / np.sqrt(Dh)
+    head_idx = jnp.arange(KV)[None, None, :]
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        a = lp["attn"]
+        attn_in = rms_norm(x, lp["attn_norm"], config.rms_eps)
+        q = (attn_in @ a["wq"].astype(x.dtype) + a["bq"].astype(x.dtype)).reshape(b, tchunk, H, Dh)
+        k = (attn_in @ a["wk"].astype(x.dtype) + a["bk"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        v = (attn_in @ a["wv"].astype(x.dtype) + a["bv"].astype(x.dtype)).reshape(b, tchunk, KV, Dh)
+        q = apply_rotary(q, cos, sin, safe_pos)
+        k = apply_rotary(k, cos, sin, safe_pos)
+        kpool = kpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale)
+        x = x + out.reshape(b, tchunk, H * Dh) @ a["wo"].astype(x.dtype)
+        mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
+        x = x + swiglu_mlp(lp["mlp"], mlp_in)
+        return x, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(x.dtype)
+    return logits, {"k": new_k, "v": new_v}

@@ -155,6 +155,28 @@ def gelu_mlp(params, x):
     return (h @ params["w_fc2"].astype(x.dtype)) + params["b_fc2"].astype(x.dtype)
 
 
+# -------------------------------------------------------- paged-serving shared
+def paged_chunk_indices(tokens, n_tokens, start_pos, block_tables, num_blocks: int,
+                        block_size: int):
+    """Shared index scaffolding for every family's ``forward_paged``: maps the
+    ragged chunk's absolute positions onto paged-KV pool coordinates.
+
+    Returns (safe_pos [N,T], valid [N,T], lengths [N], blk [N,T], off [N,T]):
+    ``blk``/``off`` address pool[blk, :, off] for each token's KV write, with
+    padded tokens routed to the trash block (``num_blocks - 1``).
+    """
+    b, tchunk = tokens.shape
+    trash = num_blocks - 1
+    positions = start_pos[:, None] + jnp.arange(tchunk)[None, :]
+    valid = jnp.arange(tchunk)[None, :] < n_tokens[:, None]
+    safe_pos = jnp.where(valid, positions, 0)
+    lengths = start_pos + n_tokens
+    blk = jnp.take_along_axis(block_tables, safe_pos // block_size, axis=1)
+    blk = jnp.where(valid, blk, trash)
+    off = jnp.where(valid, safe_pos % block_size, 0)
+    return safe_pos, valid, lengths, blk, off
+
+
 # ----------------------------------------------------------------- losses
 def cross_entropy_loss(logits, labels, ignore_index=-100, z_loss=0.0):
     """Token cross entropy with masking; logits [B,S,V], labels [B,S] int."""

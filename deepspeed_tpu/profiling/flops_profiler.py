@@ -90,18 +90,24 @@ def per_module_profile(params: Any, tokens: int, top_k: int = 0):
 
     elementwise_pat = _re.compile(r"(?:^|[._])(?:\w*norm\w*|bias|b|scale|ln\w*|g)(?:$|[._])")
     lookup_pat = _re.compile(r"(?:^|[._])(?:embed\w*|wte|wpe|tok\w*)(?:$|[._])")
+    head_pat = _re.compile(r"(?:^|[._])(?:lm_head|unembed|output\w*)(?:$|[._])")
+
+    all_keys = [key_of(p) for p, _ in flat]
+    # no explicit unembedding leaf => embeddings are tied: the embed table is
+    # also the logits projection, the model's biggest matmul
+    tied_unembed = not any(head_pat.search(k) for k in all_keys)
 
     rows = []
-    for path, leaf in flat:
-        key = key_of(path)
+    for (path, leaf), key in zip(flat, all_keys):
         n = int(np.size(leaf))
         if elementwise_pat.search(key) or np.ndim(leaf) < 2:
-            # norms/biases (possibly layer-stacked): one multiply-add per
-            # element of the trailing feature dim per token
-            feat = int(np.shape(leaf)[-1]) if np.ndim(leaf) >= 1 else 1
-            flops = float(tokens * max(feat, 1))
+            # norms/biases: one multiply-add per element per token; stacked
+            # [L, D] leaves apply all L per token, so the whole nelem counts
+            flops = float(tokens * max(n, 1))
         elif lookup_pat.search(key):
             flops = float(tokens * int(np.shape(leaf)[-1]))  # gather copy
+            if tied_unembed:
+                flops += 2.0 * tokens * n  # + the tied logits matmul
         else:
             flops = 2.0 * tokens * n       # one matmul pass per token
         rows.append({"module": key, "params": n, "flops": flops})

@@ -21,6 +21,12 @@ from ...utils.logging import log_dist, logger
 class CheckpointEngine:
     """Persistence strategy for checkpoint leaves."""
 
+    # file-backed engines persisting plain .npy at the target path can accept
+    # shard-streamed writes (checkpointing._write_leaf_streaming fills the
+    # file via memmap, synchronously) — plug-in engines with their own storage
+    # keep this False and receive gathered arrays through save()
+    supports_streaming_save = False
+
     def makedirs(self, path: str):
         os.makedirs(path, exist_ok=True)
 
@@ -38,6 +44,8 @@ class CheckpointEngine:
 class NativeCheckpointEngine(CheckpointEngine):
     """Synchronous .npy writer (TorchCheckpointEngine analog)."""
 
+    supports_streaming_save = True
+
     def save(self, arr: np.ndarray, path: str) -> None:
         np.save(path, arr)
 
@@ -49,6 +57,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
     """Background-thread writer (NebulaCheckpointEngine analog): save() enqueues
     an already-host-resident array and returns immediately; commit() drains the
     queue.  One writer thread preserves write order."""
+
+    supports_streaming_save = True  # same .npy-at-path layout; the streamed
+    # write is synchronous, trading this leaf's async for the memory bound
 
     def __init__(self, max_queue: int = 64):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)

@@ -106,7 +106,7 @@ def _write_leaf_streaming(leaf, target: str, engine) -> bool:
         # rank 0 while others enter it would desync the collective.  All ranks
         # take the gather path together.
         return False
-    if not isinstance(engine, NativeCheckpointEngine):
+    if not getattr(engine, "supports_streaming_save", False):
         return False  # plug-in engines define their own persistence
     try:
         out = np.lib.format.open_memmap(target, mode="w+", dtype=np.dtype(leaf.dtype),

@@ -1,0 +1,92 @@
+"""OPT / Falcon / Phi / Qwen model families: training forward + paged-serving
+parity (reference inference/v2/model_implementations per-model tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import falcon, opt, phi, qwen
+
+FAMILIES = [
+    (opt, opt.OPTConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, seq=64)),
+    (falcon, falcon.FalconConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, kv_heads=1, seq=64)),
+    (phi, phi.PhiConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, seq=64)),
+    (qwen, qwen.QwenConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, kv_heads=2, seq=64)),
+]
+
+
+@pytest.mark.parametrize("mod,cfg", FAMILIES, ids=lambda f: getattr(f, "__name__", ""))
+def test_forward_and_grads(mod, cfg):
+    params = mod.init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    logits = mod.forward(cfg, params, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    loss_fn = mod.make_loss_fn(cfg)
+    batch = mod.causal_lm_batch(ids)
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree_util.tree_leaves(grads))
+
+
+@pytest.mark.parametrize("mod,cfg", FAMILIES, ids=lambda f: getattr(f, "__name__", ""))
+def test_paged_prefill_matches_forward(mod, cfg):
+    """One whole-prompt chunk through forward_paged == the training forward
+    (same math, paged KV layout + kernel fallback path)."""
+    params = mod.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    T = 12
+    prompts = np.stack([rng.integers(1, cfg.vocab_size, (T,)) for _ in range(2)])
+    cache = mod.init_paged_cache(cfg, num_blocks=16, block_size=8, dtype=jnp.float32)
+    tables = np.full((2, 4), 15, np.int32)  # block 15 = trash
+    tables[0, :2] = [0, 1]
+    tables[1, :2] = [2, 3]
+    logits, new_cache = mod.forward_paged(
+        cfg, params, jnp.asarray(prompts), jnp.asarray([T, T]), jnp.asarray([0, 0]),
+        jnp.asarray(tables), cache, block_size=8)
+    ref = mod.forward(cfg, params, prompts)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4, rtol=2e-4)
+    # KV actually landed in the pool blocks
+    assert float(jnp.abs(new_cache["k"][:, :4]).sum()) > 0
+
+
+@pytest.mark.parametrize("mod,cfg", FAMILIES, ids=lambda f: getattr(f, "__name__", ""))
+def test_paged_decode_step(mod, cfg):
+    """Chunked prefill then a single-token decode chunk: logits at the decode
+    position match the full forward's last position."""
+    params = mod.init_params(cfg, jax.random.PRNGKey(2))
+    rng = np.random.default_rng(2)
+    T = 9
+    prompt = rng.integers(1, cfg.vocab_size, (1, T))
+    cache = mod.init_paged_cache(cfg, num_blocks=8, block_size=8, dtype=jnp.float32)
+    tables = np.full((1, 3), 7, np.int32)
+    tables[0, :2] = [0, 1]
+    _, cache = mod.forward_paged(cfg, params, jnp.asarray(prompt[:, :T - 1]),
+                                 jnp.asarray([T - 1]), jnp.asarray([0]),
+                                 jnp.asarray(tables), cache, block_size=8)
+    logits, _ = mod.forward_paged(cfg, params, jnp.asarray(prompt[:, T - 1:]),
+                                  jnp.asarray([1]), jnp.asarray([T - 1]),
+                                  jnp.asarray(tables), cache, block_size=8)
+    ref = mod.forward(cfg, params, prompt)[:, -1]
+    np.testing.assert_allclose(np.asarray(logits[:, 0]), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_engine_trains_each_family(mesh8):
+    """Every family plugs into deepspeed_tpu.initialize and the loss drops."""
+    import deepspeed_tpu
+    for mod, cfg in FAMILIES[:2]:  # opt + falcon keep runtime modest
+        params = mod.init_params(cfg, jax.random.PRNGKey(3))
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            loss_fn=mod.make_loss_fn(cfg), model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 5e-3}},
+                    "zero_optimization": {"stage": 2}, "bf16": {"enabled": False}})
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, cfg.vocab_size, (eng.train_batch_size, 17))
+        batch = mod.causal_lm_batch(ids)  # fixed batch: memorization must kick in
+        losses = [float(eng.train_batch(batch).loss) for _ in range(5)]
+        assert losses[-1] < losses[0], (mod.__name__, losses)
+        from deepspeed_tpu.parallel import reset_topology
+        reset_topology()

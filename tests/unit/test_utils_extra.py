@@ -191,6 +191,12 @@ def test_per_module_profile_classification():
                          "wq": np.zeros((4, 64, 64))},         # [L, in, out] stacked proj
               "embed": np.zeros((1000, 64))}
     rows = {r["module"]: r for r in per_module_profile(params, tokens=100)}
-    assert rows["layers.attn_norm"]["flops"] == 100 * 64          # elementwise
-    assert rows["embed"]["flops"] == 100 * 64                     # lookup copy
+    # stacked [L, D] norm: all L applications count
+    assert rows["layers.attn_norm"]["flops"] == 100 * 4 * 64
+    # no lm_head leaf => tied: lookup copy + the tied logits matmul
+    assert rows["embed"]["flops"] == 100 * 64 + 2.0 * 100 * 1000 * 64
     assert rows["layers.wq"]["flops"] == 2.0 * 100 * 4 * 64 * 64  # all L matmuls
+    # with an explicit head, embed is a pure lookup again
+    params2 = dict(params, lm_head=np.zeros((64, 1000)))
+    rows2 = {r["module"]: r for r in per_module_profile(params2, tokens=100)}
+    assert rows2["embed"]["flops"] == 100 * 64
