@@ -68,10 +68,17 @@ def is_initialized() -> bool:
 
 
 def get_rank(group=None) -> int:
+    """Rank within ``group`` (a comm.groups.ProcessGroup) or the process index
+    (reference comm.py:547 — group=None means the world group)."""
+    if group is not None and hasattr(group, "rank"):
+        return group.rank()
     return jax.process_index()
 
 def get_world_size(group=None) -> int:
-    """Host-process world size (device-level parallelism is the mesh's business)."""
+    """Size of ``group`` (device count over its mesh axes) or the host-process
+    world size (device-level parallelism is the mesh's business)."""
+    if group is not None and hasattr(group, "size"):
+        return group.size()
     return jax.process_count()
 
 
@@ -92,7 +99,13 @@ def barrier(group=None):
 # In-graph collectives (usable under shard_map / pjit with named mesh axes)
 # --------------------------------------------------------------------------
 
-AxisArg = Union[str, Sequence[str]]
+AxisArg = Union[str, Sequence[str]]  # or a comm.groups.ProcessGroup
+
+
+def _axes(axis):
+    """Unwrap a ProcessGroup into its mesh-axes tuple (lax takes str|tuple)."""
+    ax = getattr(axis, "axes", axis)
+    return ax if isinstance(ax, str) else tuple(ax)
 
 
 def _trace_log(op: str, x) -> None:
@@ -107,6 +120,7 @@ def _trace_log(op: str, x) -> None:
 def all_reduce(x, axis: AxisArg, op: str = "sum"):
     """lax.psum/pmax/pmin over a mesh axis (reference comm.py:478 all_reduce)."""
     _trace_log("all_reduce", x)
+    axis = _axes(axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "avg" or op == "mean":
@@ -122,30 +136,32 @@ def all_gather(x, axis: AxisArg, *, tiled: bool = True, gather_dim: int = 0):
     """Gather shards along a mesh axis (reference all_gather_into_tensor comm.py:308).
     tiled=True concatenates along ``gather_dim`` (the flat-bucket layout ZeRO uses)."""
     _trace_log("all_gather", x)
-    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+    return lax.all_gather(x, _axes(axis), axis=gather_dim, tiled=tiled)
 
 
 def reduce_scatter(x, axis: AxisArg, *, scatter_dim: int = 0, tiled: bool = True):
     """Reduce + scatter shards (reference reduce_scatter_fn comm.py:246)."""
     _trace_log("reduce_scatter", x)
-    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=tiled)
+    return lax.psum_scatter(x, _axes(axis), scatter_dimension=scatter_dim, tiled=tiled)
 
 
 def all_to_all(x, axis: AxisArg, *, split_dim: int, concat_dim: int, tiled: bool = True):
     """All-to-all over a mesh axis (reference all_to_all_single comm.py:334) —
     the Ulysses/MoE dispatch primitive."""
     _trace_log("all_to_all", x)
-    return lax.all_to_all(x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
+    return lax.all_to_all(x, _axes(axis), split_axis=split_dim, concat_axis=concat_dim, tiled=tiled)
 
 
 def ppermute(x, axis: AxisArg, perm):
     """Point-to-point ring shift — the TPU-native analog of pipeline p2p send/recv
     (reference runtime/pipe/p2p.py:50,71); perm is [(src, dst), ...]."""
     _trace_log("ppermute", x)
-    return lax.ppermute(x, axis, perm)
+    return lax.ppermute(x, _axes(axis), perm)
 
 
 def axis_index(axis: AxisArg):
+    if hasattr(axis, "axis_index"):
+        return axis.axis_index()  # ProcessGroup: linearized over its axes
     return lax.axis_index(axis)
 
 
@@ -154,6 +170,7 @@ def broadcast(x, axis: AxisArg, src: int = 0):
     Implemented as select + psum (ppermute requires unique sources; select rather
     than multiply so non-src NaN/Inf shards cannot poison the sum)."""
     _trace_log("broadcast", x)
+    axis = _axes(axis)
     idx = lax.axis_index(axis)
     contribution = jnp.where(idx == src, x, jnp.zeros_like(x))
     return lax.psum(contribution, axis)

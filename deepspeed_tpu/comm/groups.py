@@ -1,0 +1,123 @@
+"""Process-group abstraction over mesh axes.
+
+Analog of the reference's group machinery (deepspeed/utils/groups.py factory
+functions + torch.distributed ``new_group``, comm.py:181): where the reference
+builds NCCL communicators from explicit rank lists, the TPU-native "group" is
+a SCOPE OVER NAMED MESH AXES — every collective in this codebase already takes
+axis names, so a ProcessGroup is a first-class handle bundling axes with
+rank/size queries usable both eagerly (host planning) and in-graph
+(lax.axis_index).
+
+Arbitrary rank subsets are intentionally unsupported: GSPMD collectives ride
+the mesh's factorization, and every reference use-case (dp/tp/ep/sp/pp
+subgroups, hpZ secondary shards, local all-to-all groups) is an axis — or an
+axis factoring, which MeshTopology owns.  ``new_group(ranks=...)`` therefore
+raises with guidance instead of silently doing something slow.
+"""
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, PIPE_AXIS,
+                             SEQUENCE_AXIS, TENSOR_AXIS, MeshTopology, get_topology)
+
+
+class ProcessGroup:
+    """A communication scope = an ordered tuple of mesh axes."""
+
+    def __init__(self, axes: Union[str, Sequence[str]], topology: Optional[MeshTopology] = None):
+        self.axes: Tuple[str, ...] = (axes,) if isinstance(axes, str) else tuple(axes)
+        self._topo = topology
+        for a in self.axes:
+            if a not in self.topology.mesh.axis_names:
+                raise ValueError(f"unknown mesh axis {a!r}; mesh has {self.topology.mesh.axis_names}")
+
+    @property
+    def topology(self) -> MeshTopology:
+        return self._topo or get_topology()
+
+    # ------------------------------------------------------------------ sizes
+    def size(self) -> int:
+        s = 1
+        for a in self.axes:
+            s *= self.topology.axis_size(a)
+        return s
+
+    # ------------------------------------------------------------------ ranks
+    def axis_index(self):
+        """In-graph rank along this group (call inside shard_map/jit):
+        linearized over the group's axes, first axis slowest."""
+        from jax import lax
+        idx = 0
+        for a in self.axes:
+            idx = idx * self.topology.axis_size(a) + lax.axis_index(a)
+        return idx
+
+    def rank(self) -> int:
+        """Eager rank: the group-coordinate of this process's FIRST addressable
+        device in the mesh (host-side planning; in-graph code uses
+        axis_index())."""
+        import jax
+        mesh = self.topology.mesh
+        dev = jax.local_devices()[0]
+        coords = np.argwhere(mesh.devices == dev)
+        if coords.size == 0:  # device not in mesh (e.g. cpu fallback): rank 0
+            return 0
+        coord = coords[0]
+        names = mesh.axis_names
+        r = 0
+        for a in self.axes:
+            r = r * self.topology.axis_size(a) + int(coord[names.index(a)])
+        return r
+
+    def __repr__(self):
+        return f"ProcessGroup(axes={self.axes}, size={self.size()})"
+
+
+def new_group(axes: Union[str, Sequence[str], None] = None, ranks=None,
+              topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    """Create a group scope (reference comm.new_group:181).
+
+    Pass ``axes`` (a mesh axis name or tuple).  Passing torch-style ``ranks``
+    raises: arbitrary subsets don't map to GSPMD — re-factor the mesh instead
+    (MeshTopology.from_axis_dict), which is how hpZ/qgZ/MoE groups are built.
+    """
+    if ranks is not None:
+        raise NotImplementedError(
+            "rank-list groups don't exist under GSPMD — declare a mesh axis for "
+            "the scope (MeshTopology.from_axis_dict) and pass axes=...; every "
+            "reference subgroup (dp/tp/ep/sp, hpZ secondary, local a2a) is an "
+            "axis or an axis factoring")
+    if axes is None:
+        # torch.distributed.new_group() with no args means ALL ranks
+        return get_world_group(topology)
+    return ProcessGroup(axes, topology)
+
+
+# ---------------------------------------------------------- named accessors
+# Reference utils/groups.py surface (``_get_data_parallel_group`` etc.)
+def get_world_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    topo = topology or get_topology()
+    return ProcessGroup(tuple(topo.mesh.axis_names), topo)
+
+
+def get_data_parallel_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    """dp = data x fsdp (the ZeRO sharding scope, reference seq_data_parallel)."""
+    return ProcessGroup((DATA_AXIS, FSDP_AXIS), topology)
+
+
+def get_model_parallel_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    return ProcessGroup((TENSOR_AXIS,), topology)
+
+
+def get_expert_parallel_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    return ProcessGroup((EXPERT_AXIS,), topology)
+
+
+def get_sequence_parallel_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    return ProcessGroup((SEQUENCE_AXIS,), topology)
+
+
+def get_pipe_parallel_group(topology: Optional[MeshTopology] = None) -> ProcessGroup:
+    return ProcessGroup((PIPE_AXIS,), topology)

@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .transformer import (apply_rotary, cross_entropy_loss, layer_norm,
+from .transformer import (apply_rotary, causal_lm_batch, count_params,
+                          cross_entropy_loss, init_paged_kv_pool, layer_norm,
                           paged_chunk_indices, rotary_tables, sdpa)
 
 
@@ -80,9 +81,7 @@ def init_params(config: PhiConfig, key, dtype=jnp.float32):
 
 
 def num_params(config: PhiConfig) -> int:
-    return sum(int(np.prod(np.shape(l)))
-               for l in jax.tree_util.tree_leaves(
-                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+    return count_params(lambda: init_params(config, jax.random.PRNGKey(0)))
 
 
 def _block(config: PhiConfig, lp, x, cos, sin, attention_fn=None):
@@ -124,17 +123,11 @@ def make_loss_fn(config: PhiConfig, attention_fn=None) -> Callable:
     return loss_fn
 
 
-def causal_lm_batch(ids):
-    ids = np.asarray(ids)
-    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
-
-
 # --------------------------------------------------------- paged (ragged) serve
 def init_paged_cache(config: PhiConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
-    L, H = config.num_layers, config.num_heads
-    Dh = config.hidden_size // H
-    return {"k": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype),
-            "v": jnp.zeros((L, num_blocks, H, block_size, Dh), dtype)}
+    return init_paged_kv_pool(config.num_layers, config.num_heads,
+                              config.hidden_size // config.num_heads,
+                              num_blocks, block_size, dtype)
 
 
 def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_tables,

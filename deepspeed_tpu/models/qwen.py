@@ -21,8 +21,8 @@ import numpy as np
 
 from . import llama
 from .llama import LlamaConfig
-from .transformer import (apply_rotary, cross_entropy_loss, paged_chunk_indices,
-                          rms_norm, rotary_tables, sdpa, swiglu_mlp)
+from .transformer import (apply_rotary, count_params, cross_entropy_loss,
+                          paged_chunk_indices, rms_norm, rotary_tables, sdpa, swiglu_mlp)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,9 +54,7 @@ def init_params(config: QwenConfig, key, dtype=jnp.float32):
 
 
 def num_params(config: QwenConfig) -> int:
-    return sum(int(np.prod(np.shape(l)))
-               for l in jax.tree_util.tree_leaves(
-                   jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+    return count_params(lambda: init_params(config, jax.random.PRNGKey(0)))
 
 
 def _block(config: QwenConfig, lp, x, cos, sin, attention_fn=None):

@@ -155,6 +155,28 @@ def gelu_mlp(params, x):
     return (h @ params["w_fc2"].astype(x.dtype)) + params["b_fc2"].astype(x.dtype)
 
 
+# ------------------------------------------------------- model-family shared
+def causal_lm_batch(ids):
+    """Shift token ids into (input_ids, labels) next-token pairs."""
+    ids = np.asarray(ids)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def count_params(init_fn) -> int:
+    """Parameter count without materializing (jax.eval_shape over init)."""
+    shapes = jax.eval_shape(init_fn)
+    return sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(shapes))
+
+
+def init_paged_kv_pool(num_layers: int, num_kv_heads: int, head_dim: int,
+                       num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    """Paged KV pool [L, NB, KV, bs, Dh] — heads-major so the Pallas paged
+    kernel's trailing (bs, Dh) tile satisfies TPU tiling; the last block is
+    the trash target for padded-token writes."""
+    shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
 # -------------------------------------------------------- paged-serving shared
 def paged_chunk_indices(tokens, n_tokens, start_pos, block_tables, num_blocks: int,
                         block_size: int):

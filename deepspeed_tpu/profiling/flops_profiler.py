@@ -89,12 +89,14 @@ def per_module_profile(params: Any, tokens: int, top_k: int = 0):
         return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
 
     elementwise_pat = _re.compile(r"(?:^|[._])(?:\w*norm\w*|bias|b|scale|ln\w*|g)(?:$|[._])")
-    lookup_pat = _re.compile(r"(?:^|[._])(?:embed\w*|wte|wpe|tok\w*)(?:$|[._])")
+    pos_pat = _re.compile(r"(?:^|[._])(?:pos\w*|wpe)(?:$|[._])")
+    lookup_pat = _re.compile(r"(?:^|[._])(?:embed\w*|wte|wpe|pos\w*|tok\w*)(?:$|[._])")
     head_pat = _re.compile(r"(?:^|[._])(?:lm_head|unembed|output\w*)(?:$|[._])")
 
     all_keys = [key_of(p) for p, _ in flat]
-    # no explicit unembedding leaf => embeddings are tied: the embed table is
-    # also the logits projection, the model's biggest matmul
+    # no explicit unembedding leaf => embeddings are tied: the TOKEN embed
+    # table is also the logits projection, the model's biggest matmul
+    # (positional tables are lookups only — they never unembed)
     tied_unembed = not any(head_pat.search(k) for k in all_keys)
 
     rows = []
@@ -106,7 +108,7 @@ def per_module_profile(params: Any, tokens: int, top_k: int = 0):
             flops = float(tokens * max(n, 1))
         elif lookup_pat.search(key):
             flops = float(tokens * int(np.shape(leaf)[-1]))  # gather copy
-            if tied_unembed:
+            if tied_unembed and not pos_pat.search(key):
                 flops += 2.0 * tokens * n  # + the tied logits matmul
         else:
             flops = 2.0 * tokens * n       # one matmul pass per token

@@ -156,3 +156,43 @@ def test_allgather_bandwidth_microbench(mesh8):
                                 topology=mesh8, iters=2, compiled_loop=True)
     assert np.isfinite(res2["busbw_gbps"]) and res2["busbw_gbps"] > 0
     assert res2["bytes"] == res["bytes"]
+
+
+# ------------------------------------------------------------- process groups
+def test_process_group_sizes_and_accessors(mesh_2x4):
+    from deepspeed_tpu.comm import (ProcessGroup, get_data_parallel_group,
+                                    get_model_parallel_group, get_world_group,
+                                    get_rank, get_world_size, new_group)
+    dp = get_data_parallel_group(mesh_2x4)
+    tp = get_model_parallel_group(mesh_2x4)
+    assert get_world_size(dp) == 2  # data=2, fsdp=1
+    assert get_world_size(tp) == 4
+    assert get_world_size(get_world_group(mesh_2x4)) == 8
+    assert get_rank(dp) == 0  # single-process: first device sits at origin
+    g = new_group(axes=("data", "tensor"), topology=mesh_2x4)
+    assert g.size() == 8
+    with pytest.raises(NotImplementedError, match="mesh axis"):
+        new_group(ranks=[0, 1])
+    with pytest.raises(ValueError):
+        ProcessGroup("bogus", mesh_2x4)
+
+
+def test_process_group_in_graph_collectives(mesh_2x4):
+    """ProcessGroup passes straight into the collective wrappers in-graph,
+    including multi-axis groups (psum over data x tensor)."""
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.comm import ProcessGroup
+    g_all = ProcessGroup(("data", "tensor"), mesh_2x4)
+    g_tp = ProcessGroup("tensor", mesh_2x4)
+
+    def fn(x):
+        total = comm.all_reduce(x, g_all)            # sums over all 8 shards
+        tp_ranks = comm.axis_index(g_tp).reshape(1, 1)
+        return total, tp_ranks
+
+    out, ranks = jax.jit(shard_map(fn, mesh=mesh_2x4.mesh,
+                                   in_specs=P("data", "tensor"),
+                                   out_specs=(P(), P("data", "tensor")),
+                                   check_vma=False))(jnp.ones((2, 4)))
+    np.testing.assert_array_equal(np.asarray(out), np.full((1, 1), 8.0))
+    np.testing.assert_array_equal(np.asarray(ranks).ravel(), [0, 1, 2, 3, 0, 1, 2, 3])

@@ -200,3 +200,13 @@ def test_per_module_profile_classification():
     params2 = dict(params, lm_head=np.zeros((64, 1000)))
     rows2 = {r["module"]: r for r in per_module_profile(params2, tokens=100)}
     assert rows2["embed"]["flops"] == 100 * 64
+
+
+def test_per_module_profile_pos_embed_no_phantom_unembed():
+    """Positional tables are lookups only — the tied logits matmul attaches to
+    the token embedding, never to pos_embed/wpe."""
+    from deepspeed_tpu.profiling.flops_profiler import per_module_profile
+    params = {"embed": np.zeros((1000, 64)), "pos_embed": np.zeros((2048, 64))}
+    rows = {r["module"]: r for r in per_module_profile(params, tokens=100)}
+    assert rows["pos_embed"]["flops"] == 100 * 64              # pure lookup
+    assert rows["embed"]["flops"] == 100 * 64 + 2.0 * 100 * 1000 * 64
