@@ -4,7 +4,7 @@ Trains a Llama-style causal LM with the full engine on the available device(s)
 and reports model FLOPs utilization.  The measured config is the north-star
 shape (BASELINE.md): **ZeRO-3**, bf16 compute + fp32 master, Pallas flash
 attention, Pallas fused AdamW — at the largest model that fills this chip's
-HBM (438M params, seq 2048, on a single 16GB v5e).
+HBM (~542M params, hidden 2048, seq 2048, on a single 16GB v5e).
 
 vs_baseline divides by the 0.40 MFU target BASELINE.md sets for the reference
 (ZeRO-3 Llama ≥40% MFU); extra.vs_ulysses_54pct compares against the Ulysses
@@ -77,10 +77,11 @@ def main():
 
     on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
-        # largest config that fits 16GB HBM with fp32 master+moments resident
-        # (16 bytes/param optimizer footprint + remat'd activations)
-        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-                                num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=2048)
+        # best measured config that fits 16GB HBM with fp32 master+moments
+        # resident (sweep r2): 2048x8/542M hit 0.540 MFU vs 0.536 for
+        # 1536x12/438M; 2048x10 and micro>8 OOM at compile, micro=6 regressed
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                                num_layers=8, num_heads=16, num_kv_heads=16, max_seq_len=2048)
         micro, seq, steps = 8, 2048, 30
     else:  # CPU smoke fallback
         cfg = llama.LlamaConfig.tiny()
