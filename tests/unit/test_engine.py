@@ -187,3 +187,49 @@ def test_eval_batch():
     batch = random_batch(8, hidden=HIDDEN, seed=0)
     loss = engine.eval_batch(batch)
     assert np.isfinite(float(loss))
+
+
+def test_save_16bit_model(tmp_path, mesh8):
+    import deepspeed_tpu
+    from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=32, nlayers=2)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, model_parameters=params, topology=mesh8,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}})
+    eng.train_batch(random_batch(eng.train_batch_size, 32, seed=0))
+    path = eng.save_16bit_model(str(tmp_path))
+    from safetensors.numpy import load_file
+    loaded = load_file(path)
+    assert "layer_0.w" in loaded
+    w = loaded["layer_0.w"]
+    assert w.shape == (32, 32)
+    # compute dtype (bf16 default) round-trips through safetensors
+    assert w.dtype == np.asarray(jnp.zeros((), eng.compute_dtype)).dtype
+    # values match the live fp32 master within cast tolerance
+    master = eng.get_fp32_params()["layer_0"]["w"]
+    np.testing.assert_allclose(np.asarray(w, np.float32), master, atol=2e-2, rtol=2e-2)
+
+
+def test_wall_clock_breakdown_logs(mesh8):
+    import deepspeed_tpu
+    from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=16, nlayers=1)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, model_parameters=params, topology=mesh8,
+        config={"train_micro_batch_size_per_gpu": 1, "steps_per_print": 2,
+                "wall_clock_breakdown": True,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}})
+    import io
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    ds_logger.addHandler(h)
+    try:
+        for i in range(2):
+            eng.train_batch(random_batch(eng.train_batch_size, 16, seed=i))
+    finally:
+        ds_logger.removeHandler(h)
+    assert "wall clock breakdown" in buf.getvalue()
