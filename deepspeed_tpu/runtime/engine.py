@@ -757,17 +757,23 @@ class Engine:
         one safetensors file keyed by pytree path (the HF deployment format;
         bf16-native, unlike .npz)."""
         from safetensors.numpy import save_file
-        from .checkpointing import _leaf_key
+        from .checkpointing import _is_rank0, _leaf_key
         os.makedirs(save_dir, exist_ok=True)
         params = (self._offload_host_state()["params"] if self.offload_device is not None
                   else self.state.params)
         rep = NamedSharding(self.topology.mesh, PartitionSpec())
+        ct = self.compute_dtype
+        # cast BEFORE replicating: the gather then moves 2 bytes/param, not 4
+        # (the reference gathers the bit16 copy for the same reason), which is
+        # why this doesn't reuse checkpointing._gather_to_host (fp32 path)
+        gather16 = jax.jit(lambda x: x.astype(ct), out_shardings=rep)
         out = {}
         for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             if isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1:
-                leaf = jax.device_put(leaf, rep)  # one leaf gathered at a time
-            out[_leaf_key(keypath)] = np.asarray(jnp.asarray(leaf, self.compute_dtype))
+                leaf = gather16(leaf)  # one leaf replicated at a time, in 16-bit
+            out[_leaf_key(keypath)] = np.asarray(jnp.asarray(leaf, ct))
         out_path = os.path.join(save_dir, filename)
-        save_file(out, out_path)
+        if _is_rank0():  # shared storage: exactly one writer
+            save_file(out, out_path)
         log_dist(f"saved 16-bit model weights ({len(out)} leaves) -> {out_path}", ranks=[0])
         return out_path

@@ -90,3 +90,31 @@ def test_engine_trains_each_family(mesh8):
         assert losses[-1] < losses[0], (mod.__name__, losses)
         from deepspeed_tpu.parallel import reset_topology
         reset_topology()
+
+
+def test_falcon_tp_sharded_forward_parity(mesh_2x4):
+    """auto_tp rules shard the new families' projections over 'tensor'; the
+    GSPMD forward must match the unsharded one (reference AutoTP parity)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from deepspeed_tpu.inference.auto_tp import auto_tp_rules
+    from deepspeed_tpu.runtime.zero.sharding import build_sharding_plan
+
+    mod, cfg = FAMILIES[1]  # falcon
+    params = mod.init_params(cfg, jax.random.PRNGKey(0))
+
+    class _NoZero:
+        stage = 0
+        param_persistence_threshold = 0
+
+    plan = build_sharding_plan(_NoZero(), mesh_2x4, tp_rules=auto_tp_rules)
+    shardings = plan.param_shardings(params)
+    sharded = jax.jit(lambda p: p, out_shardings=shardings)(params)
+    # projections actually sharded over tensor
+    spec = sharded["layers"]["wq"].sharding.spec
+    assert "tensor" in str(spec), spec
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16))
+    ref = mod.forward(cfg, params, ids)
+    out = jax.jit(lambda p: mod.forward(cfg, p, ids))(sharded)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)

@@ -394,3 +394,42 @@ def test_1f1b_epilogue_hooks_run_once():
     eng.train_batch(params, mbs, labels)
     assert calls["reduce"] == S  # once per stage gradient tree
     assert calls["step"] == 1
+
+
+def test_pipeline_composes_with_tensor_parallel():
+    """PP x TP: pipe-sharded layer stacks with tensor-sharded inner dims
+    train through the engine on a pipe=2 x tensor=2 x data=2 mesh."""
+    topo = MeshTopology.from_axis_dict({"pipe": 2, "tensor": 2, "data": 2})
+    set_topology(topo)
+    pipe = PipelineModule(_layer_fn, num_stages=2, topo=topo)
+    params = {"pipe_layers": restack_for_pipeline(_init_layers(jax.random.PRNGKey(2)), 2),
+              "head": jnp.zeros((HIDDEN, HIDDEN))}
+
+    def rules(path, shape):
+        if "pipe_layers" in path:
+            return (0, "pipe")
+        if path.endswith("head"):
+            return (1, "tensor")
+        return None
+
+    def loss_fn(p, batch, rng):
+        x = batch["x"]
+        xm = x.reshape(2, x.shape[0] // 2, HIDDEN)
+        out = pipe(p["pipe_layers"], xm).reshape(x.shape)
+        pred = out @ p["head"].astype(out.dtype)
+        return jnp.mean((pred - batch["y"].astype(pred.dtype)) ** 2).astype(jnp.float32)
+
+    import deepspeed_tpu
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, model_parameters=params, topology=topo, tp_rules=rules,
+        config={"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 1}, "bf16": {"enabled": False}})
+    assert "pipe" in str(engine.state.params["pipe_layers"]["w"].sharding.spec)
+    assert "tensor" in str(engine.state.params["head"].sharding.spec)
+    rng = np.random.default_rng(1)
+    w_true = rng.normal(size=(HIDDEN, HIDDEN)).astype(np.float32) * 0.2
+    x = rng.normal(size=(engine.train_batch_size, HIDDEN)).astype(np.float32)
+    batch = {"x": x, "y": np.tanh(x @ w_true)}
+    losses = [float(engine.train_batch(batch).loss) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
