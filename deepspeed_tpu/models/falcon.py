@@ -147,3 +147,71 @@ def forward_paged(config: FalconConfig, params, tokens, n_tokens, start_pos, blo
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
     logits = x @ params["embed"].T.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
+
+
+# ----------------------------------------------------------------- HF import
+def config_from_hf(hf_config) -> FalconConfig:
+    if getattr(hf_config, "new_decoder_architecture", False):
+        raise NotImplementedError(
+            "new-decoder-architecture Falcon (40B/180B: ln_attn/ln_mlp split "
+            "norms) is not supported by this importer")
+    if getattr(hf_config, "alibi", False):
+        raise NotImplementedError("alibi Falcon variants (falcon-rw) are not "
+                                  "supported — this implementation is rotary")
+    if getattr(hf_config, "bias", False):
+        raise NotImplementedError("bias=True Falcon variants are not supported")
+    if not getattr(hf_config, "parallel_attn", True):
+        raise NotImplementedError("sequential-attention Falcon variants "
+                                  "(parallel_attn=False) are not supported")
+    # old decoder architecture: multi-query -> 1 kv head, else full MHA
+    kv = 1 if getattr(hf_config, "multi_query", True) else hf_config.num_attention_heads
+    return FalconConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                        num_layers=hf_config.num_hidden_layers,
+                        num_heads=hf_config.num_attention_heads, num_kv_heads=kv,
+                        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+                        rope_theta=getattr(hf_config, "rope_theta", 10000.0))
+
+
+def from_hf_state_dict(config: FalconConfig, state_dict, dtype=jnp.float32):
+    """Convert a FalconForCausalLM state dict.  HF stores one FUSED
+    query_key_value projection [ (H + 2*KV) * Dh, D ] laid out q-then-k-then-v
+    (multi-query: all H query slices first); split into our wq/wk/wv."""
+    from .transformer import hf_stack, hf_tensor
+    t = lambda name: hf_tensor(state_dict, name)
+    H, KV = config.num_heads, config.num_kv_heads
+    Dh = config.hidden_size // H
+    L = config.num_layers
+    pre = "transformer.h.{}"
+
+    wq, wk, wv = [], [], []
+    for i in range(L):
+        qkv = t(f"transformer.h.{i}.self_attention.query_key_value.weight")  # [(H+2KV)Dh, D]
+        if KV == 1:  # multi-query: [q x H, k, v]
+            q, k, v = qkv[:H * Dh], qkv[H * Dh:(H + 1) * Dh], qkv[(H + 1) * Dh:]
+        else:  # grouped: interleaved per-group [q x (H/KV), k, v]
+            grp = H // KV
+            blocks = qkv.reshape(KV, (grp + 2) * Dh, -1)
+            q = blocks[:, :grp * Dh].reshape(H * Dh, -1)
+            k = blocks[:, grp * Dh:(grp + 1) * Dh].reshape(KV * Dh, -1)
+            v = blocks[:, (grp + 1) * Dh:].reshape(KV * Dh, -1)
+        wq.append(q.T)
+        wk.append(k.T)
+        wv.append(v.T)
+
+    stack = lambda fmt, transpose=True: hf_stack(state_dict, fmt, L, dtype, transpose)
+
+    return {
+        "embed": jnp.asarray(t("transformer.word_embeddings.weight"), dtype),
+        "layers": {
+            "ln_w": stack(pre + ".input_layernorm.weight", False),
+            "ln_b": stack(pre + ".input_layernorm.bias", False),
+            "wq": jnp.asarray(np.stack(wq), dtype),
+            "wk": jnp.asarray(np.stack(wk), dtype),
+            "wv": jnp.asarray(np.stack(wv), dtype),
+            "wo": stack(pre + ".self_attention.dense.weight"),
+            "fc1": stack(pre + ".mlp.dense_h_to_4h.weight"),
+            "fc2": stack(pre + ".mlp.dense_4h_to_h.weight"),
+        },
+        "final_ln_w": jnp.asarray(t("transformer.ln_f.weight"), dtype),
+        "final_ln_b": jnp.asarray(t("transformer.ln_f.bias"), dtype),
+    }

@@ -153,3 +153,55 @@ def forward_paged(config: OPTConfig, params, tokens, n_tokens, start_pos, block_
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
     logits = x @ params["embed"].T.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
+
+
+# ----------------------------------------------------------------- HF import
+def config_from_hf(hf_config) -> OPTConfig:
+    if not getattr(hf_config, "do_layer_norm_before", True):
+        raise NotImplementedError(
+            "post-LN OPT variants (do_layer_norm_before=False, e.g. opt-350m) "
+            "are not supported — this implementation is pre-LN")
+    if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
+        raise NotImplementedError(
+            "OPT variants with word_embed_proj_dim != hidden_size (project_in/out "
+            "layers, e.g. opt-350m) are not supported")
+    return OPTConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                     ffn_dim=hf_config.ffn_dim, num_layers=hf_config.num_hidden_layers,
+                     num_heads=hf_config.num_attention_heads,
+                     max_seq_len=hf_config.max_position_embeddings)
+
+
+def from_hf_state_dict(config: OPTConfig, state_dict, dtype=jnp.float32):
+    """Convert an OPTForCausalLM state dict (module_inject/load_checkpoint.py
+    analog).  HF's learned positional table already contains the +2 offset
+    rows; torch Linear [out, in] transposes to our [in, out]."""
+    from .transformer import hf_stack, hf_tensor
+    t = lambda name: hf_tensor(state_dict, name)
+    L = config.num_layers
+    pre = "model.decoder.layers.{}"
+    stack = lambda fmt, transpose=True: hf_stack(state_dict, fmt, L, dtype, transpose)
+
+    return {
+        "embed": jnp.asarray(t("model.decoder.embed_tokens.weight"), dtype),
+        "pos_embed": jnp.asarray(t("model.decoder.embed_positions.weight"), dtype),
+        "layers": {
+            "ln1_w": stack(pre + ".self_attn_layer_norm.weight", False),
+            "ln1_b": stack(pre + ".self_attn_layer_norm.bias", False),
+            "ln2_w": stack(pre + ".final_layer_norm.weight", False),
+            "ln2_b": stack(pre + ".final_layer_norm.bias", False),
+            "wq": stack(pre + ".self_attn.q_proj.weight"),
+            "wk": stack(pre + ".self_attn.k_proj.weight"),
+            "wv": stack(pre + ".self_attn.v_proj.weight"),
+            "wo": stack(pre + ".self_attn.out_proj.weight"),
+            "bq": stack(pre + ".self_attn.q_proj.bias", False),
+            "bk": stack(pre + ".self_attn.k_proj.bias", False),
+            "bv": stack(pre + ".self_attn.v_proj.bias", False),
+            "bo": stack(pre + ".self_attn.out_proj.bias", False),
+            "fc1": stack(pre + ".fc1.weight"),
+            "b_fc1": stack(pre + ".fc1.bias", False),
+            "fc2": stack(pre + ".fc2.weight"),
+            "b_fc2": stack(pre + ".fc2.bias", False),
+        },
+        "final_ln_w": jnp.asarray(t("model.decoder.final_layer_norm.weight"), dtype),
+        "final_ln_b": jnp.asarray(t("model.decoder.final_layer_norm.bias"), dtype),
+    }

@@ -167,3 +167,47 @@ def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
     logits = x @ params["lm_head"].astype(x.dtype) + params["lm_head_b"].astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
+
+
+# ----------------------------------------------------------------- HF import
+def config_from_hf(hf_config) -> PhiConfig:
+    return PhiConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
+                     ffn_dim=hf_config.intermediate_size,
+                     num_layers=hf_config.num_hidden_layers,
+                     num_heads=hf_config.num_attention_heads,
+                     max_seq_len=hf_config.max_position_embeddings,
+                     partial_rotary_factor=getattr(hf_config, "partial_rotary_factor", 0.4),
+                     rope_theta=getattr(hf_config, "rope_theta", 10000.0))
+
+
+def from_hf_state_dict(config: PhiConfig, state_dict, dtype=jnp.float32):
+    """Convert a PhiForCausalLM state dict (biases everywhere, untied head)."""
+    from .transformer import hf_stack, hf_tensor
+    t = lambda name: hf_tensor(state_dict, name)
+    L = config.num_layers
+    pre = "model.layers.{}"
+    stack = lambda fmt, transpose=True: hf_stack(state_dict, fmt, L, dtype, transpose)
+
+    return {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "ln_w": stack(pre + ".input_layernorm.weight", False),
+            "ln_b": stack(pre + ".input_layernorm.bias", False),
+            "wq": stack(pre + ".self_attn.q_proj.weight"),
+            "bq": stack(pre + ".self_attn.q_proj.bias", False),
+            "wk": stack(pre + ".self_attn.k_proj.weight"),
+            "bk": stack(pre + ".self_attn.k_proj.bias", False),
+            "wv": stack(pre + ".self_attn.v_proj.weight"),
+            "bv": stack(pre + ".self_attn.v_proj.bias", False),
+            "wo": stack(pre + ".self_attn.dense.weight"),
+            "bo": stack(pre + ".self_attn.dense.bias", False),
+            "fc1": stack(pre + ".mlp.fc1.weight"),
+            "b_fc1": stack(pre + ".mlp.fc1.bias", False),
+            "fc2": stack(pre + ".mlp.fc2.weight"),
+            "b_fc2": stack(pre + ".mlp.fc2.bias", False),
+        },
+        "final_ln_w": jnp.asarray(t("model.final_layernorm.weight"), dtype),
+        "final_ln_b": jnp.asarray(t("model.final_layernorm.bias"), dtype),
+        "lm_head": jnp.asarray(t("lm_head.weight").T, dtype),
+        "lm_head_b": jnp.asarray(t("lm_head.bias"), dtype),
+    }

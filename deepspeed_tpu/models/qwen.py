@@ -141,3 +141,23 @@ def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = x @ head.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v}
+
+
+# ----------------------------------------------------------------- HF import
+def config_from_hf(hf_config) -> QwenConfig:
+    base = llama.config_from_hf(hf_config)
+    return QwenConfig(**dataclasses.asdict(base))
+
+
+def from_hf_state_dict(config: QwenConfig, state_dict, dtype=jnp.float32):
+    """Qwen2ForCausalLM = llama layout + q/k/v biases."""
+    params = llama.from_hf_state_dict(config, state_dict, dtype)
+
+    from .transformer import hf_stack
+    L = config.num_layers
+    stack_bias = lambda fmt: hf_stack(state_dict, fmt, L, dtype, transpose=False)
+
+    params["layers"]["attn"]["bq"] = stack_bias("model.layers.{}.self_attn.q_proj.bias")
+    params["layers"]["attn"]["bk"] = stack_bias("model.layers.{}.self_attn.k_proj.bias")
+    params["layers"]["attn"]["bv"] = stack_bias("model.layers.{}.self_attn.v_proj.bias")
+    return params

@@ -177,6 +177,22 @@ def init_paged_kv_pool(num_layers: int, num_kv_heads: int, head_dim: int,
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+
+
+# ------------------------------------------------------ HF state-dict helpers
+def hf_tensor(state_dict, name):
+    """torch tensor / array -> fp32 numpy (shared by every from_hf_state_dict)."""
+    w = state_dict[name]
+    return w.float().numpy() if hasattr(w, "float") else np.asarray(w, np.float32)
+
+
+def hf_stack(state_dict, fmt, num_layers, dtype, transpose=True):
+    """Stack one per-layer HF tensor into an [L, ...] leaf, transposing torch
+    Linear [out, in] into our [in, out] unless ``transpose=False``."""
+    ws = [hf_tensor(state_dict, fmt.format(i)) for i in range(num_layers)]
+    return jnp.asarray(np.stack([w.T if transpose else w for w in ws]), dtype)
+
+
 # -------------------------------------------------------- paged-serving shared
 def paged_chunk_indices(tokens, n_tokens, start_pos, block_tables, num_blocks: int,
                         block_size: int):

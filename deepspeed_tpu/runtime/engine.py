@@ -767,13 +767,15 @@ class Engine:
         # (the reference gathers the bit16 copy for the same reason), which is
         # why this doesn't reuse checkpointing._gather_to_host (fp32 path)
         gather16 = jax.jit(lambda x: x.astype(ct), out_shardings=rep)
+        rank0 = _is_rank0()
         out = {}
         for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             if isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1:
-                leaf = gather16(leaf)  # one leaf replicated at a time, in 16-bit
-            out[_leaf_key(keypath)] = np.asarray(jnp.asarray(leaf, ct))
+                leaf = gather16(leaf)  # collective: every rank participates
+            if rank0:  # only the writer pays the D2H copy + host RAM
+                out[_leaf_key(keypath)] = np.asarray(jnp.asarray(leaf, ct))
         out_path = os.path.join(save_dir, filename)
-        if _is_rank0():  # shared storage: exactly one writer
+        if rank0:  # shared storage: exactly one writer
             save_file(out, out_path)
         log_dist(f"saved 16-bit model weights ({len(out)} leaves) -> {out_path}", ranks=[0])
         return out_path

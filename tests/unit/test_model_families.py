@@ -118,3 +118,68 @@ def test_falcon_tp_sharded_forward_parity(mesh_2x4):
     ref = mod.forward(cfg, params, ids)
     out = jax.jit(lambda p: mod.forward(cfg, p, ids))(sharded)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+# ------------------------------------------------------------- HF import parity
+def _hf_parity(mod, make_hf, atol=2e-3):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    torch.manual_seed(0)
+    hf_model = make_hf(transformers)
+    hf_model.eval()
+    cfg = mod.config_from_hf(hf_model.config)
+    params = mod.from_hf_state_dict(cfg, hf_model.state_dict())
+    ids = np.random.default_rng(0).integers(0, hf_model.config.vocab_size, (2, 12))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.float().numpy()
+    got = np.asarray(mod.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, ref, atol=atol, rtol=atol)
+
+
+def test_hf_opt_parity():
+    _hf_parity(opt, lambda tr: tr.OPTForCausalLM(tr.OPTConfig(
+        vocab_size=99, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64, do_layer_norm_before=True)))
+
+
+def test_hf_falcon_parity():
+    _hf_parity(falcon, lambda tr: tr.FalconForCausalLM(tr.FalconConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        multi_query=True, parallel_attn=True, new_decoder_architecture=False,
+        bias=False, alibi=False, max_position_embeddings=64)))
+
+
+def test_hf_phi_parity():
+    _hf_parity(phi, lambda tr: tr.PhiForCausalLM(tr.PhiConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, partial_rotary_factor=0.5,
+        max_position_embeddings=64)))
+
+
+def test_hf_qwen2_parity():
+    _hf_parity(qwen, lambda tr: tr.Qwen2ForCausalLM(tr.Qwen2Config(
+        vocab_size=99, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False)))
+
+
+def test_hf_unsupported_variants_rejected():
+    transformers = pytest.importorskip("transformers")
+    with pytest.raises(NotImplementedError, match="post-LN"):
+        opt.config_from_hf(transformers.OPTConfig(do_layer_norm_before=False))
+    with pytest.raises(NotImplementedError, match="word_embed_proj_dim"):
+        opt.config_from_hf(transformers.OPTConfig(word_embed_proj_dim=256, hidden_size=512))
+    with pytest.raises(NotImplementedError, match="new-decoder"):
+        falcon.config_from_hf(transformers.FalconConfig(new_decoder_architecture=True))
+    with pytest.raises(NotImplementedError, match="alibi"):
+        falcon.config_from_hf(transformers.FalconConfig(alibi=True))
+    with pytest.raises(NotImplementedError, match="parallel_attn"):
+        falcon.config_from_hf(transformers.FalconConfig(parallel_attn=False))
+
+
+def test_hf_falcon_mha_variant_parity():
+    """Old-arch full-MHA falcon (multi_query=False): per-head q,k,v interleave."""
+    _hf_parity(falcon, lambda tr: tr.FalconForCausalLM(tr.FalconConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        multi_query=False, parallel_attn=True, new_decoder_architecture=False,
+        bias=False, alibi=False, max_position_embeddings=64)))
