@@ -169,6 +169,7 @@ def config_from_hf(hf_config) -> FalconConfig:
                         num_layers=hf_config.num_hidden_layers,
                         num_heads=hf_config.num_attention_heads, num_kv_heads=kv,
                         max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+                        ln_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
                         rope_theta=getattr(hf_config, "rope_theta", 10000.0))
 
 

@@ -219,19 +219,10 @@ def from_hf_state_dict(config: LlamaConfig, state_dict, dtype=jnp.float32):
 
     torch Linear stores [out, in]; ours is [in, out] — transposed here.
     """
-    import numpy as _np
-
-    def t(name):
-        w = state_dict[name]
-        w = w.float().numpy() if hasattr(w, "numpy") else _np.asarray(w, dtype=_np.float32)
-        return w
-
+    from .transformer import hf_stack, hf_tensor
+    t = lambda name: hf_tensor(state_dict, name)
     L = config.num_layers
-
-    def stack(fmt, transpose=True):
-        ws = [t(fmt.format(i)) for i in range(L)]
-        ws = [w.T if transpose else w for w in ws]
-        return jnp.asarray(_np.stack(ws), dtype)
+    stack = lambda fmt, transpose=True: hf_stack(state_dict, fmt, L, dtype, transpose)
 
     params = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dtype),

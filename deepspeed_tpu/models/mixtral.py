@@ -156,13 +156,10 @@ def dense_moe_ffn(moe_params, x, top_k: int):
 def from_hf_state_dict(config: MixtralConfig, state_dict, dtype=jnp.float32):
     """Convert a HF MixtralForCausalLM state dict (block_sparse_moe naming:
     w1=gate, w3=up, w2=down) to our stacked pytree."""
-    def t(name):
-        w = state_dict[name]
-        return w.float().numpy() if hasattr(w, "float") else np.asarray(w, dtype=np.float32)
-
+    from .transformer import hf_stack, hf_tensor
+    t = lambda name: hf_tensor(state_dict, name)
     L, E = config.num_layers, config.num_experts
-    stack = lambda fmt, tr=True: jnp.asarray(
-        np.stack([(t(fmt.format(i)).T if tr else t(fmt.format(i))) for i in range(L)]), dtype)
+    stack = lambda fmt, tr=True: hf_stack(state_dict, fmt, L, dtype, tr)
 
     def stack_expert(which):
         return jnp.asarray(np.stack([

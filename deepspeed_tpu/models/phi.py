@@ -171,12 +171,19 @@ def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_
 
 # ----------------------------------------------------------------- HF import
 def config_from_hf(hf_config) -> PhiConfig:
+    if getattr(hf_config, "qk_layernorm", False):
+        raise NotImplementedError("qk_layernorm Phi variants are not supported")
+    kv = getattr(hf_config, "num_key_value_heads", None)
+    if kv is not None and kv != hf_config.num_attention_heads:
+        raise NotImplementedError("GQA Phi variants (num_key_value_heads < "
+                                  "num_attention_heads) are not supported")
     return PhiConfig(vocab_size=hf_config.vocab_size, hidden_size=hf_config.hidden_size,
                      ffn_dim=hf_config.intermediate_size,
                      num_layers=hf_config.num_hidden_layers,
                      num_heads=hf_config.num_attention_heads,
                      max_seq_len=hf_config.max_position_embeddings,
                      partial_rotary_factor=getattr(hf_config, "partial_rotary_factor", 0.4),
+                     ln_eps=getattr(hf_config, "layer_norm_eps", 1e-5),
                      rope_theta=getattr(hf_config, "rope_theta", 10000.0))
 
 

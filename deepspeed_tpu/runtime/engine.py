@@ -534,8 +534,11 @@ class Engine:
     def _shard_batch(self, batch):
         """Place a [gas, global_micro, ...] host batch with the global_micro dim
         sharded over the dp axes (DistributedSampler analog — each dp shard sees
-        its slice; engine.deepspeed_io:1686)."""
-        axes = self.plan.shard_axes if len(self.plan.shard_axes) > 1 else self.plan.shard_axes[0]
+        its slice; engine.deepspeed_io:1686).  NOT plan.shard_axes: ZeRO state
+        may also partition over 'sequence' (seq_data_parallel composition), but
+        the batch dim only spans data x fsdp."""
+        dp_axes = self.topology.data_parallel_axes()
+        axes = dp_axes if len(dp_axes) > 1 else dp_axes[0]
         sharding = NamedSharding(self.topology.mesh, PartitionSpec(None, axes))
         return jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
@@ -619,9 +622,11 @@ class Engine:
 
             self._compiled_eval = jax.jit(eval_step)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # batch dim spans the dp axes only — plan.shard_axes may also carry
+        # 'sequence' (seq_data ZeRO composition), which never splits samples
+        dp_axes = self.topology.data_parallel_axes()
         sharding = NamedSharding(self.topology.mesh,
-                                 PartitionSpec(self.plan.shard_axes if len(self.plan.shard_axes) > 1 else
-                                               self.plan.shard_axes[0]))
+                                 PartitionSpec(dp_axes if len(dp_axes) > 1 else dp_axes[0]))
         batch = jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
         params = self._compute_params if self.offload_device is not None else self.state.params
         return self._compiled_eval(params, batch, rng)

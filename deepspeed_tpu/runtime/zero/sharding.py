@@ -32,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, MeshTopology
+from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, MeshTopology
 
 # A model-parallel rule maps (dotted param path, shape) to one of:
 #   None                      — no model-parallel sharding for this leaf
@@ -82,7 +82,8 @@ class ShardingPlan:
     persistence_threshold: int = 0
     tp_rules: Optional[TpRuleFn] = None
 
-    def _spec_for_shape(self, shape, sharded: bool, path: str = "", axes=None) -> PartitionSpec:
+    def _spec_for_shape(self, shape, sharded: bool, path: str = "", axes=None,
+                        respect_persistence: bool = False) -> PartitionSpec:
         shard_axes = tuple(axes) if axes is not None else self.shard_axes
         if len(shape) == 0:
             return PartitionSpec()
@@ -99,7 +100,12 @@ class ShardingPlan:
         world = 1
         for a in shard_axes:
             world *= self.topo.axis_size(a)
-        if world == 1 or int(np.prod(shape)) <= self.persistence_threshold:
+        if world == 1:
+            return PartitionSpec(*spec)
+        if respect_persistence and int(np.prod(shape)) <= self.persistence_threshold:
+            # persistent small params stay gathered (reference
+            # param_persistence_threshold, partition_parameters.py:1479) —
+            # COMPUTE params only; master/moments always partition
             return PartitionSpec(*spec)
         zero_axes = shard_axes if len(shard_axes) > 1 else shard_axes[0]
         # largest dim divisible by the shard world, excluding pinned dims;
@@ -116,18 +122,22 @@ class ShardingPlan:
                     break
         return PartitionSpec(*spec)
 
-    def _tree_shardings(self, tree, sharded: bool, axes=None):
+    def _tree_shardings(self, tree, sharded: bool, axes=None, respect_persistence: bool = False):
         flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
         out = [
-            NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(leaf), sharded, _path_str(path), axes=axes))
+            NamedSharding(self.topo.mesh,
+                          self._spec_for_shape(np.shape(leaf), sharded, _path_str(path),
+                                               axes=axes, respect_persistence=respect_persistence))
             for path, leaf in flat
         ]
         return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- roles ---------------------------------------------------------------
     def param_shardings(self, params):
-        """Compute (bit16) params: sharded only at stage 3."""
-        return self._tree_shardings(params, sharded=self.stage >= 3)
+        """Compute (bit16) params: sharded only at stage 3; leaves at or under
+        param_persistence_threshold stay gathered (persistent params)."""
+        return self._tree_shardings(params, sharded=self.stage >= 3,
+                                    respect_persistence=True)
 
     def master_shardings(self, master_params):
         """FP32 master copy: sharded from stage 1 up."""
@@ -160,7 +170,12 @@ class ShardingPlan:
 
 
 def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRuleFn] = None) -> ShardingPlan:
-    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS) if topo.axis_size(a) > 1) or (DATA_AXIS, )
+    # ZeRO states shard over data x fsdp x SEQUENCE: params are replicated
+    # across sequence ranks, so they join the partitioning pool — the
+    # reference's seq_data_parallel_group-as-ZeRO-dp-group composition
+    # (engine.py:1515) that lets Ulysses + ZeRO-3 reach long sequences
+    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS)
+                 if topo.axis_size(a) > 1) or (DATA_AXIS, )
     mics = int(getattr(zero_config, "mics_shard_size", -1) or -1)
     if mics > 0 and zero_config.stage >= 3:
         # MiCS (reference runtime/zero/mics.py:48): ZeRO-3 scoped to a shard

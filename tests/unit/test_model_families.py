@@ -183,3 +183,40 @@ def test_hf_falcon_mha_variant_parity():
         vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
         multi_query=False, parallel_attn=True, new_decoder_architecture=False,
         bias=False, alibi=False, max_position_embeddings=64)))
+
+
+def test_hf_eps_and_phi_variant_guards():
+    transformers = pytest.importorskip("transformers")
+    fc = falcon.config_from_hf(transformers.FalconConfig(
+        layer_norm_epsilon=3e-6, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False))
+    assert fc.ln_eps == 3e-6
+    pc = phi.config_from_hf(transformers.PhiConfig(layer_norm_eps=2e-6))
+    assert pc.ln_eps == 2e-6
+    with pytest.raises(NotImplementedError, match="qk_layernorm"):
+        phi.config_from_hf(transformers.PhiConfig(qk_layernorm=True))
+    with pytest.raises(NotImplementedError, match="GQA"):
+        phi.config_from_hf(transformers.PhiConfig(num_attention_heads=8,
+                                                  num_key_value_heads=2))
+
+
+def test_eval_batch_under_sequence_parallel():
+    """eval_batch shards the batch over dp axes only (not plan.shard_axes,
+    which may carry 'sequence')."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.parallel import MeshTopology, reset_topology, set_topology
+    from deepspeed_tpu.sequence import ulysses_attention
+    reset_topology()
+    topo = MeshTopology.from_axis_dict({"data": 2, "sequence": 4})
+    set_topology(topo)
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=8, kv_heads=8, seq=32)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=llama.make_loss_fn(cfg, attention_fn=ulysses_attention()),
+        model_parameters=llama.init_params(cfg, jax.random.PRNGKey(0)), topology=topo,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}, "bf16": {"enabled": False}})
+    ids = np.random.default_rng(0).integers(0, 64, (eng.train_batch_size, 32))
+    loss = float(eng.eval_batch(llama.causal_lm_batch(ids)))
+    assert np.isfinite(loss)
