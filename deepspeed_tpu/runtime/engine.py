@@ -372,12 +372,18 @@ class Engine:
         compute_shardings = None
         if self.zero_stage < 3:
             # Replicated over dp (keeping any tensor-parallel dims sharded): the
-            # bit16-allgather analog.  Stage 3 leaves layout to GSPMD so gathers
-            # happen per-layer inside the scan, not up front.
+            # bit16-allgather analog.
             compute_shardings = self.plan.param_shardings(self.state.params)
         elif hpz:
             # hpZ secondary partition: compute copy sharded over fsdp only
             compute_shardings = self.plan.secondary_shardings(self.state.params)
+        elif self.plan.persistence_threshold > 0:
+            # stage 3: pin the compute copy to the plan's layout — big leaves
+            # sharded (per-layer gathers ride the scan), persistent small
+            # leaves REPLICATED (param_persistence_threshold semantics,
+            # partition_parameters.py:1479).  threshold=0 leaves layout to
+            # GSPMD entirely.
+            compute_shardings = self.plan.param_shardings(self.state.params)
 
         def cast_for_compute(master):
             if qwz:

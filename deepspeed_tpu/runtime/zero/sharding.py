@@ -151,8 +151,11 @@ class ShardingPlan:
         """hpZ secondary partition (reference zero_hpz_partition_size,
         partition_parameters.py:1171): the compute copy sharded over the fast
         intra-slice 'fsdp' axis only — the 'data' gather happens ONCE at the
-        secondary materialization, per-layer gathers then ride fsdp/ICI."""
-        return self._tree_shardings(params, sharded=True, axes=(FSDP_AXIS, ))
+        secondary materialization, per-layer gathers then ride fsdp/ICI.
+        Persistent small params stay gathered here too (same compute-copy
+        contract as param_shardings)."""
+        return self._tree_shardings(params, sharded=True, axes=(FSDP_AXIS, ),
+                                    respect_persistence=True)
 
     def grad_shardings(self, grads):
         """Gradients: sharded from stage 2 up (reduce-scatter instead of allreduce)."""
@@ -185,6 +188,11 @@ def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRu
         if topo.axis_size(FSDP_AXIS) != mics:
             raise ValueError(f"mics_shard_size={mics} requires mesh axis fsdp={mics} "
                              f"(got fsdp={topo.axis_size(FSDP_AXIS)}); replicas ride 'data'")
+        if topo.axis_size(SEQUENCE_AXIS) > 1:
+            from ...utils.logging import logger
+            logger.warning("MiCS shard groups are fsdp-scoped: ZeRO state will "
+                           "REPLICATE across the sequence axis (no seq_data "
+                           "composition under mics_shard_size)")
         axes = (FSDP_AXIS, )
     threshold = zero_config.param_persistence_threshold if zero_config.stage >= 3 else 0
     return ShardingPlan(topo=topo,
