@@ -21,10 +21,12 @@ def main():
         cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
                                 num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
         n_seqs, prompt_len, decode_steps = 32, 256, 64
+        burst_k = 32
         num_blocks, block_size, maxb = 2048, 32, 64
     else:
         cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=128)
         n_seqs, prompt_len, decode_steps = 4, 16, 4
+        burst_k = 2
         num_blocks, block_size, maxb = 64, 8, 8
 
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -46,8 +48,23 @@ def main():
     for _ in range(decode_steps):
         produced += len(eng.step())
     dt = time.perf_counter() - t0
-    print(json.dumps({"metric": "v2_decode_tokens_per_sec", "value": round(produced / dt, 1),
-                      "extra": {"n_seqs": n_seqs, "prompt_len": prompt_len,
+    stepwise = produced / dt
+
+    # burst path: k decode steps inside one compiled program (the CUDA-graph
+    # decode-loop analog; removes the per-token host round-trip)
+    k = burst_k
+    out = eng.decode_burst(k)  # compile
+    assert out is not None, "burst inapplicable at bench config (pool/seq-len bound)"
+    t0 = time.perf_counter()
+    burst_tokens = 0
+    for _ in range(max(2, decode_steps // k)):
+        out = eng.decode_burst(k)
+        assert out is not None, "burst fell back mid-bench (pool exhausted?)"
+        burst_tokens += sum(len(v) for v in out.values())
+    dt = time.perf_counter() - t0
+    print(json.dumps({"metric": "v2_decode_burst_tokens_per_sec", "value": round(burst_tokens / dt, 1),
+                      "extra": {"stepwise_tokens_per_sec": round(stepwise, 1),
+                                "burst_k": k, "n_seqs": n_seqs, "prompt_len": prompt_len,
                                 "params_m": round(llama.num_params(cfg) / 1e6, 1)}}))
 
 

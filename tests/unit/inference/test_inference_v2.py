@@ -175,3 +175,22 @@ def test_engine_factory_registry():
     assert len(out[0]) == 5
     with pytest.raises(ValueError, match="v2 serving supports"):
         build_engine("falcon", cfg, params)
+
+
+def test_decode_burst_bounded_by_max_seq_len():
+    """A burst that would push positions past the rotary table must decline
+    (silent clamping would produce wrong tokens)."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                            num_blocks=32, block_size=8, max_blocks_per_seq=8,
+                            token_budget=16, max_seqs_per_step=4)
+    eng.put([0], [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10]])
+    while not eng.step():
+        pass
+    # 11 seen + 1 pending; k=8 would hit position 20 > max_seq_len 16
+    assert eng.decode_burst(8) is None
+    out = eng.decode_burst(4)  # 11 + 1 + 4 = 16 <= 16: fits
+    assert out is not None and len(out[0]) == 4

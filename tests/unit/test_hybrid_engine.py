@@ -7,7 +7,6 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.models import llama
-from deepspeed_tpu.parallel import MeshTopology, set_topology
 from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
 from deepspeed_tpu.runtime.config import load_config
 
