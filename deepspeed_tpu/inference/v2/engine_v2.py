@@ -163,18 +163,23 @@ class InferenceEngineV2:
         if len(live) > self.scheduler.max_seqs:
             return None
         max_pos = getattr(self.model_config, "max_seq_len", None)
+        bs = self.block_size
+        total_new = 0
         for seq in live:
-            if (seq.seen_tokens + 1 + k + self.block_size - 1) // self.block_size > self.max_blocks_per_seq:
+            upto = seq.seen_tokens + 1 + k
+            if self.manager.over_cap(upto):
                 return None
-            if max_pos is not None and seq.seen_tokens + 1 + k > max_pos:
+            if max_pos is not None and upto > max_pos:
                 # positions past the rotary table would silently clamp — the
                 # burst pre-commits k future positions, so bound them here
                 return None
-        try:
-            for seq in live:
-                self.manager.ensure_blocks(seq, seq.seen_tokens + 1 + k)
-        except RuntimeError:
-            return None  # pool exhausted: fall back to stepwise scheduling
+            total_new += max(0, (upto + bs - 1) // bs - len(seq.blocks))
+        if total_new > self.manager.allocator.free_blocks:
+            # check BEFORE allocating anything: a partial grab would strand
+            # blocks on some sequences and starve the stepwise fallback
+            return None
+        for seq in live:
+            self.manager.ensure_blocks(seq, seq.seen_tokens + 1 + k)
 
         n = self._bucket(len(live))
         b = min(self._bucket(max(len(s.blocks) for s in live)), self.max_blocks_per_seq)

@@ -32,7 +32,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, MeshTopology
+from ...parallel.mesh import (DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQUENCE_AXIS,
+                              TENSOR_AXIS, MeshTopology)
 
 # A model-parallel rule maps (dotted param path, shape) to one of:
 #   None                      — no model-parallel sharding for this leaf
@@ -173,11 +174,13 @@ class ShardingPlan:
 
 
 def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRuleFn] = None) -> ShardingPlan:
-    # ZeRO states shard over data x fsdp x SEQUENCE: params are replicated
-    # across sequence ranks, so they join the partitioning pool — the
-    # reference's seq_data_parallel_group-as-ZeRO-dp-group composition
-    # (engine.py:1515) that lets Ulysses + ZeRO-3 reach long sequences
-    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS)
+    # ZeRO states shard over data x fsdp x SEQUENCE x EXPERT: params are
+    # replicated across sequence and expert ranks, so both join the
+    # partitioning pool — the reference's seq_data_parallel_group
+    # (engine.py:1515) and expert_data_parallel groups (groups.py:113)
+    # as-ZeRO-dp-group compositions.  Expert-sharded leaves keep their
+    # pinned expert dim; the zero axes land on another dim.
+    axes = tuple(a for a in (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, EXPERT_AXIS)
                  if topo.axis_size(a) > 1) or (DATA_AXIS, )
     mics = int(getattr(zero_config, "mics_shard_size", -1) or -1)
     if mics > 0 and zero_config.stage >= 3:
@@ -188,11 +191,12 @@ def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRu
         if topo.axis_size(FSDP_AXIS) != mics:
             raise ValueError(f"mics_shard_size={mics} requires mesh axis fsdp={mics} "
                              f"(got fsdp={topo.axis_size(FSDP_AXIS)}); replicas ride 'data'")
-        if topo.axis_size(SEQUENCE_AXIS) > 1:
+        replicated = [a for a in (SEQUENCE_AXIS, EXPERT_AXIS) if topo.axis_size(a) > 1]
+        if replicated:
             from ...utils.logging import logger
-            logger.warning("MiCS shard groups are fsdp-scoped: ZeRO state will "
-                           "REPLICATE across the sequence axis (no seq_data "
-                           "composition under mics_shard_size)")
+            logger.warning(f"MiCS shard groups are fsdp-scoped: ZeRO state will "
+                           f"REPLICATE across {replicated} (no seq/expert_data "
+                           f"composition under mics_shard_size)")
         axes = (FSDP_AXIS, )
     threshold = zero_config.param_persistence_threshold if zero_config.stage >= 3 else 0
     return ShardingPlan(topo=topo,

@@ -194,3 +194,27 @@ def test_decode_burst_bounded_by_max_seq_len():
     assert eng.decode_burst(8) is None
     out = eng.decode_burst(4)  # 11 + 1 + 4 = 16 <= 16: fits
     assert out is not None and len(out[0]) == 4
+
+
+def test_decode_burst_declines_cleanly_when_pool_tight():
+    """A burst that cannot pre-allocate for EVERY live sequence must decline
+    without grabbing any blocks (partial grabs starve the stepwise fallback)."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                            num_blocks=8, block_size=8, max_blocks_per_seq=8,
+                            token_budget=32, max_seqs_per_step=4)
+    eng.put([0, 1], [[1] * 12, [2] * 12])
+    while len(eng.step()) < 2:
+        pass
+    free_before = eng.manager.allocator.free_blocks
+    # 13 seen + 1 + 32 -> 6 blocks/seq; pool (7 usable) can't grow both
+    assert eng.decode_burst(32) is None
+    assert eng.manager.allocator.free_blocks == free_before  # nothing stranded
+    # generate still completes via the stepwise fallback
+    eng.flush(0)
+    eng.flush(1)
+    out = eng.generate([[5, 6, 7]], max_new_tokens=4)
+    assert len(out[0]) == 7

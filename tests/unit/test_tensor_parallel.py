@@ -93,3 +93,25 @@ def test_mixtral_trains_with_ep():
     batch = llama.causal_lm_batch(ids)
     losses = [float(engine.train_batch(batch).loss) for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+def test_mixtral_zero_shards_over_expert_axis():
+    """ZeRO states partition over the expert axis too (reference
+    expert_data_parallel groups, groups.py:113): attention masters/moments are
+    replicated across EP ranks and join the pool."""
+    topo = MeshTopology.from_axis_dict({"data": 2, "expert": 4})
+    cfg = mixtral.MixtralConfig.tiny(experts=4)
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mixtral.make_loss_fn(cfg, topo=topo), model_parameters=params,
+        topology=topo,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}, "bf16": {"enabled": False}})
+    specs = [str(l.sharding.spec) for l in jax.tree_util.tree_leaves(engine.state.opt_state)]
+    assert any("expert" in s for s in specs), specs
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (engine.train_batch_size, 32))
+    from deepspeed_tpu.models.transformer import causal_lm_batch
+    batch = causal_lm_batch(ids)
+    losses = [float(engine.train_batch(batch).loss) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
