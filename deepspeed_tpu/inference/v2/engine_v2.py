@@ -163,7 +163,6 @@ class InferenceEngineV2:
         if len(live) > self.scheduler.max_seqs:
             return None
         max_pos = getattr(self.model_config, "max_seq_len", None)
-        bs = self.block_size
         total_new = 0
         for seq in live:
             upto = seq.seen_tokens + 1 + k
@@ -173,8 +172,8 @@ class InferenceEngineV2:
                 # positions past the rotary table would silently clamp — the
                 # burst pre-commits k future positions, so bound them here
                 return None
-            total_new += max(0, (upto + bs - 1) // bs - len(seq.blocks))
-        if total_new > self.manager.allocator.free_blocks:
+            total_new += self.manager.blocks_needed(seq, upto)
+        if not self.manager.can_allocate(total_new):
             # check BEFORE allocating anything: a partial grab would strand
             # blocks on some sequences and starve the stepwise fallback
             return None

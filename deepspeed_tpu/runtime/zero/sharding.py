@@ -98,8 +98,12 @@ class ShardingPlan:
                     pinned[dim] = axis
         if not sharded:
             return PartitionSpec(*spec)
+        # a mesh axis may appear in a PartitionSpec only once: axes already
+        # pinned by tp_rules (tensor, expert, ...) leave the ZeRO pool for
+        # this leaf
+        avail = tuple(a for a in shard_axes if a not in pinned.values())
         world = 1
-        for a in shard_axes:
+        for a in avail:
             world *= self.topo.axis_size(a)
         if world == 1:
             return PartitionSpec(*spec)
@@ -108,7 +112,7 @@ class ShardingPlan:
             # param_persistence_threshold, partition_parameters.py:1479) —
             # COMPUTE params only; master/moments always partition
             return PartitionSpec(*spec)
-        zero_axes = shard_axes if len(shard_axes) > 1 else shard_axes[0]
+        zero_axes = avail if len(avail) > 1 else avail[0]
         # largest dim divisible by the shard world, excluding pinned dims;
         # fall back to stacking zero axes onto a pinned dim if it alone divides
         candidates = [(d, s) for d, s in enumerate(shape) if s % world == 0 and d not in pinned]
@@ -116,7 +120,7 @@ class ShardingPlan:
             dim = max(candidates, key=lambda t: t[1])[0]
             spec[dim] = zero_axes
         else:
-            za = shard_axes if len(shard_axes) > 1 else (shard_axes[0], )
+            za = avail if len(avail) > 1 else (avail[0], )
             for dim, axis in pinned.items():
                 if shape[dim] % (world * self.topo.axis_size(axis)) == 0:
                     spec[dim] = (axis, *za)

@@ -115,3 +115,27 @@ def test_mixtral_zero_shards_over_expert_axis():
     batch = causal_lm_batch(ids)
     losses = [float(engine.train_batch(batch).loss) for _ in range(4)]
     assert losses[-1] < losses[0], losses
+
+
+def test_zero_pool_excludes_pinned_axes():
+    """A leaf whose dim is pinned on an axis in the ZeRO pool must not get
+    that axis twice in its PartitionSpec."""
+    from deepspeed_tpu.runtime.zero.sharding import build_sharding_plan
+    topo = MeshTopology.from_axis_dict({"data": 2, "expert": 4})
+
+    def rules(path, shape):
+        if "experts" in path:
+            return (0, "expert")
+        return None
+
+    class Z:
+        stage = 1
+        param_persistence_threshold = 0
+        mics_shard_size = -1
+
+    plan = build_sharding_plan(Z(), topo, tp_rules=rules)
+    assert "expert" in plan.shard_axes
+    spec = plan._spec_for_shape((4, 16, 64), True, "layers.experts.w")
+    flat = [a for p in spec for a in ((p,) if isinstance(p, str) else (p or ()))]
+    assert flat.count("expert") == 1, spec  # pinned once, not re-added by ZeRO
+    assert "data" in flat, spec             # ZeRO still shards over data
