@@ -120,10 +120,9 @@ class ShardingPlan:
             dim = max(candidates, key=lambda t: t[1])[0]
             spec[dim] = zero_axes
         else:
-            za = avail if len(avail) > 1 else (avail[0], )
             for dim, axis in pinned.items():
                 if shape[dim] % (world * self.topo.axis_size(axis)) == 0:
-                    spec[dim] = (axis, *za)
+                    spec[dim] = (axis, *avail)
                     break
         return PartitionSpec(*spec)
 
@@ -169,12 +168,16 @@ class ShardingPlan:
     def constrain_grads(self, grads):
         """Annotate gradients inside the jitted step so XLA lowers the dp reduction
         to reduce-scatter (stage>=2) rather than allreduce — the analog of
-        average_tensor's rank-sliced reduce (stage_1_and_2.py:1020)."""
+        average_tensor's rank-sliced reduce (stage_1_and_2.py:1020).  The leaf
+        path threads through so tp/expert pins match grad_shardings exactly
+        (a pathless spec would drop pins and force per-step reshards)."""
         if self.stage < 2:
             return grads
-        return jax.tree_util.tree_map(
-            lambda g: jax.lax.with_sharding_constraint(
-                g, NamedSharding(self.topo.mesh, self._spec_for_shape(np.shape(g), True))), grads)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, g: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.topo.mesh,
+                                 self._spec_for_shape(np.shape(g), True, _path_str(path)))),
+            grads)
 
 
 def build_sharding_plan(zero_config, topo: MeshTopology, tp_rules: Optional[TpRuleFn] = None) -> ShardingPlan:
