@@ -1,5 +1,6 @@
 """FastGen-style ragged/continuous-batching serving (reference deepspeed/inference/v2/)."""
 from .blocked_allocator import BlockedAllocator
+from .engine_factory import build_engine, build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .ragged_manager import RaggedStateManager, SequenceDescriptor
 from .scheduler import ScheduledChunk, SplitFuseScheduler

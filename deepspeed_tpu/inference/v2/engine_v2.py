@@ -11,12 +11,15 @@ ragged kernels.
 """
 
 import functools
+import inspect
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
+from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...utils.logging import log_dist
 from ..config import DTYPES as _DTYPES, load_inference_config
 from .ragged_manager import RaggedStateManager
@@ -27,7 +30,8 @@ class InferenceEngineV2:
     def __init__(self, model_module, model_config, params, config: Optional[Dict] = None,
                  num_blocks: int = 512, block_size: int = 16,
                  max_blocks_per_seq: int = 64, token_budget: int = 256,
-                 max_seqs_per_step: int = 32):
+                 max_seqs_per_step: int = 32,
+                 topology: Optional[MeshTopology] = None):
         self.config = load_inference_config(config)
         self.model = model_module
         self.model_config = model_config
@@ -35,13 +39,40 @@ class InferenceEngineV2:
         self.block_size = block_size
         self.manager = RaggedStateManager(num_blocks, block_size, max_blocks_per_seq)
         self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step)
-        self.params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.dtype), params)
-        self.kv = model_module.init_paged_cache(model_config, num_blocks, block_size, dtype=self.dtype)
+        self.topology = topology
+        self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
+        params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.dtype), params)
+        kv = model_module.init_paged_cache(model_config, num_blocks, block_size, dtype=self.dtype)
+        if self.tp > 1:
+            # TP-sharded serving (reference engine_v2.py:81 builds on a TP group;
+            # sharding helpers inference/v2/model_implementations/sharding/)
+            from . import tp as _tp
+            if "tp_axis" not in inspect.signature(model_module.forward_paged).parameters:
+                raise NotImplementedError(
+                    f"{model_module.__name__}.forward_paged has no tp_axis support yet; "
+                    f"TP v2 serving covers llama/mistral/mixtral")
+            _tp.validate_model(model_config, self.tp)
+            self._param_specs = _tp.param_specs(model_module, params, self.tp)
+            self._kv_specs = _tp.kv_pool_spec(kv)
+            params = _tp.place(topology, params, self._param_specs)
+            kv = _tp.place(topology, kv, self._kv_specs)
+        self.params = params
+        self.kv = kv
         self._fwd_cache: Dict = {}
         self._rng = jax.random.PRNGKey(self.config.seed)
         self.max_blocks_per_seq = max_blocks_per_seq
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{block_size} "
-                 f"budget={token_budget} dtype={self.config.dtype}", ranks=[0])
+                 f"budget={token_budget} dtype={self.config.dtype} tp={self.tp}", ranks=[0])
+
+    def _shard_mapped(self, inner, out_specs):
+        """Wrap a (params, kv, *replicated) forward for TP: replicated
+        activations in, sharded params/KV, psums inside via tp_axis."""
+        from jax import shard_map
+        n_rep = len(inspect.signature(inner).parameters) - 2
+        rep = tuple(PartitionSpec() for _ in range(n_rep))
+        return shard_map(inner, mesh=self.topology.mesh,
+                         in_specs=(self._param_specs, self._kv_specs) + rep,
+                         out_specs=out_specs, check_vma=False)
 
     # ------------------------------------------------------------------ intake
     def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]]) -> None:
@@ -57,10 +88,16 @@ class InferenceEngineV2:
         key = (n, t, b)
         if key not in self._fwd_cache:
             model, cfg, bs = self.model, self.model_config, self.block_size
-
-            def fwd(params, kv, tokens, n_tokens, start_pos, tables):
-                return model.forward_paged(cfg, params, tokens, n_tokens, start_pos, tables,
-                                           kv, block_size=bs)
+            if self.tp > 1:
+                def fwd(params, kv, tokens, n_tokens, start_pos, tables):
+                    return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
+                                               tables, kv, block_size=bs,
+                                               tp_axis=TENSOR_AXIS)
+                fwd = self._shard_mapped(fwd, (PartitionSpec(), self._kv_specs))
+            else:
+                def fwd(params, kv, tokens, n_tokens, start_pos, tables):
+                    return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
+                                               tables, kv, block_size=bs)
 
             self._fwd_cache[key] = jax.jit(fwd, donate_argnums=(1, ))
         return self._fwd_cache[key]
@@ -128,18 +165,42 @@ class InferenceEngineV2:
         if key not in self._fwd_cache:
             model, cfg, bs = self.model, self.model_config, self.block_size
             ones = jnp.ones((n, ), jnp.int32)
+            if self.tp > 1:
+                # vocab-parallel greedy: argmax the LOCAL logit shard and reduce
+                # (max value, then first-occurrence index) with O(1) scalars per
+                # token over ICI instead of all-gathering O(V) logits each step
+                tp_kw = {"tp_axis": TENSOR_AXIS, "gather_logits": False}
+                vocab = getattr(cfg, "vocab_size", None)
+
+                def pick(row):  # row [N, V_local]
+                    if vocab is not None and row.shape[-1] == vocab:
+                        return jnp.argmax(row, axis=-1).astype(jnp.int32)  # tied head: full V
+                    vlocal = row.shape[-1]
+                    local_idx = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                    local_val = jnp.max(row, axis=-1)
+                    best = jax.lax.pmax(local_val, TENSOR_AXIS)
+                    offset = jax.lax.axis_index(TENSOR_AXIS).astype(jnp.int32) * vlocal
+                    cand = jnp.where(local_val == best, local_idx + offset,
+                                     jnp.int32(2**31 - 1))
+                    return jax.lax.pmin(cand, TENSOR_AXIS).astype(jnp.int32)
+            else:
+                tp_kw = {}
+                pick = lambda row: jnp.argmax(row, axis=-1).astype(jnp.int32)
 
             def burst(params, kv, tok0, start0, tables):
                 def body(carry, _):
                     kv, tok, start = carry
                     logits, kv = model.forward_paged(cfg, params, tok[:, None], ones,
-                                                     start, tables, kv, block_size=bs)
-                    nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                                                     start, tables, kv, block_size=bs,
+                                                     **tp_kw)
+                    nxt = pick(logits[:, 0])
                     return (kv, nxt, start + 1), nxt
 
                 (kv, _, _), toks = jax.lax.scan(body, (kv, tok0, start0), None, length=k)
                 return kv, toks  # toks [K, N]
 
+            if self.tp > 1:
+                burst = self._shard_mapped(burst, (self._kv_specs, PartitionSpec()))
             self._fwd_cache[key] = jax.jit(burst, donate_argnums=(1, ))
         return self._fwd_cache[key]
 

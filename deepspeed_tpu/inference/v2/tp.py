@@ -1,0 +1,84 @@
+"""Tensor-parallel sharding for v2 (ragged/paged) serving.
+
+Analog of the reference's v2 sharding-helper tree
+(inference/v2/model_implementations/sharding/{qkv,mlp,attn,embedding,unembed}.py
++ the TP group the engine builds on, inference/v2/engine_v2.py:81-92): the
+reference hand-slices each weight per TP rank at load time; here the model's
+``tp_rules`` (or AutoTP path inference) produce a PartitionSpec tree, params and
+the paged KV pool are placed with NamedShardings, and the ragged forward runs
+under ``shard_map`` with ``tp_axis`` threading psums through the row-parallel
+matmuls (models/llama.py forward_paged).
+
+Layout (matching the reference helpers):
+  qkv (wq/wk/wv)      column-parallel — heads split over 'tensor'  (sharding/qkv.py)
+  attn out (wo)       row-parallel    — psum                       (sharding/attn.py)
+  mlp up/gate         column-parallel                              (sharding/mlp.py)
+  mlp down            row-parallel    — psum
+  embedding           replicated                                   (sharding/embedding.py)
+  lm head             vocab-parallel  — all_gather of logit shards (sharding/unembed.py)
+  paged KV pool       head-sharded    — dim 2 of [L, NB, KV, bs, Dh]
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...parallel.mesh import TENSOR_AXIS, MeshTopology
+from ...runtime.zero.sharding import _normalize_rule, _path_str
+from ..auto_tp import auto_tp_rules
+
+
+def resolve_rules(model_module) -> Callable:
+    return getattr(model_module, "tp_rules", None) or auto_tp_rules
+
+
+def param_specs(model_module, params, tp: int):
+    """PartitionSpec tree for v2 params over the 'tensor' axis.
+
+    Raises when a rule names a dim not divisible by tp — silent replication
+    there would serve wrong math under shard_map (local head counts are derived
+    from the shard shapes)."""
+    rules = resolve_rules(model_module)
+
+    def spec_for(path, leaf):
+        shape = np.shape(leaf)
+        path_s = _path_str(path)
+        dims = [None] * len(shape)
+        for dim, axis in _normalize_rule(rules(path_s, tuple(shape))):
+            if axis != TENSOR_AXIS:
+                continue  # v2 serving shards over 'tensor' only
+            if shape[dim] % tp != 0:
+                raise ValueError(
+                    f"v2 TP: param {path_s} dim {dim} ({shape[dim]}) not divisible by "
+                    f"tp={tp}; pick a tp that divides heads/ffn/vocab")
+            dims[dim] = TENSOR_AXIS
+        return PartitionSpec(*dims)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def kv_pool_spec(kv_pool) -> Any:
+    """Head-shard the paged pool: leaves are [L, NB, KV, bs, Dh]."""
+    return jax.tree_util.tree_map(lambda _: PartitionSpec(None, None, TENSOR_AXIS), kv_pool)
+
+
+def validate_model(model_config, tp: int) -> None:
+    """Head/GQA divisibility — the same constraint the reference asserts in its
+    sharding helpers (sharding/attn.py head-distribution logic)."""
+    h = getattr(model_config, "num_heads", None)
+    kv = getattr(model_config, "num_kv_heads", h)
+    if h is not None and h % tp != 0:
+        raise ValueError(f"v2 TP: num_heads={h} not divisible by tp={tp}")
+    if kv is not None and kv % tp != 0:
+        raise ValueError(
+            f"v2 TP: num_kv_heads={kv} not divisible by tp={tp} — KV-head replication "
+            f"is not implemented; use tp <= num_kv_heads")
+
+
+def place(topology: MeshTopology, tree, specs):
+    """device_put a pytree with NamedShardings from a PartitionSpec tree."""
+    mesh = topology.mesh
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)

@@ -346,7 +346,8 @@ def init_paged_cache(config: LlamaConfig, num_blocks: int, block_size: int, dtyp
 
 
 def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int, window: Optional[int] = None):
+                  kv_cache, *, block_size: int, window: Optional[int] = None,
+                  tp_axis: Optional[str] = None, gather_logits: bool = True):
     """Ragged chunked forward over the paged KV pool (FastGen model-forward
     analog, inference/v2/model_implementations/llama_v2 + blocked flash).
 
@@ -358,6 +359,13 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
     Attention runs in the Pallas paged kernel (ops/attention/paged.py) on TPU —
     only live blocks are read via scalar-prefetched table indices; off-TPU the
     identical-math dense-gather fallback runs.
+
+    ``tp_axis``: when called inside shard_map with params column/row-sharded per
+    tp_rules and the KV pool sharded on its head dim, names the mesh axis to
+    psum row-parallel partial outputs over (the TPU analog of the reference's
+    v2 sharding helpers, inference/v2/model_implementations/sharding/qkv.py +
+    attn.py + mlp.py + unembed.py).  Head counts are derived from the (local)
+    param shapes, so the same code serves single-chip and TP-sharded.
     """
     from ..ops.attention.paged import paged_attention
 
@@ -366,10 +374,12 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
-    H, KV = config.num_heads, config.num_kv_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads  # true head dim: TP-invariant
+    H = params["layers"]["attn"]["wq"].shape[-1] // Dh   # local (per-shard) heads
+    KV = params["layers"]["attn"]["wk"].shape[-1] // Dh
     scale = 1.0 / np.sqrt(Dh)
     head_idx = jnp.arange(KV)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -384,13 +394,18 @@ def forward_paged(config: LlamaConfig, params, tokens, n_tokens, start_pos, bloc
         vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
         out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
                               block_size=block_size, softmax_scale=scale, window=window)
-        x = x + out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype)
+        x = x + preduce(out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype))
         mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        x = x + swiglu_mlp(lp["mlp"], mlp_in)
+        x = x + preduce(swiglu_mlp(lp["mlp"], mlp_in))
         return x, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = x @ head.astype(x.dtype)
+    if tp_axis is not None and gather_logits and not config.tie_embeddings:
+        # lm_head is vocab-parallel (tp_rules: lm_head dim 1): gather shards.
+        # Greedy decode skips this (gather_logits=False) and argmaxes the
+        # vocab-local shard instead — O(1) scalars over ICI per token, not O(V).
+        logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
     return logits, {"k": new_k, "v": new_v}

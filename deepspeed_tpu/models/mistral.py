@@ -79,12 +79,14 @@ def make_loss_fn(config: MistralConfig, attention_fn=None) -> Callable:
 
 
 def forward_paged(config: MistralConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
     """v2 ragged forward: the paged kernel applies the sliding window directly
     (reference mistral serving uses windowed blocked flash)."""
     return llama.forward_paged(config, params, tokens, n_tokens, start_pos, block_tables,
                                kv_cache, block_size=block_size,
-                               window=config.sliding_window)
+                               window=config.sliding_window, tp_axis=tp_axis,
+                               gather_logits=gather_logits)
 
 
 def config_from_hf(hf_config) -> MistralConfig:

@@ -202,10 +202,32 @@ def init_paged_cache(config: MixtralConfig, num_blocks: int, block_size: int, dt
     return llama.init_paged_cache(_llama_view(config), num_blocks, block_size, dtype=dtype)
 
 
+def tp_rules(path: str, shape) -> "int | None":
+    """Tensor-parallel layout (reference v2 sharding helpers for mixtral:
+    inference/v2/model_implementations/sharding/ + mixtral container): attention
+    column/row split like llama; experts sharded on the intermediate dim
+    (w1/w3 column, w2 row per expert); router gate replicated."""
+    if path.endswith(("attn.wq", "attn.wk", "attn.wv")):
+        return 2  # [L, in, out] -> shard out (heads)
+    if path.endswith("attn.wo"):
+        return 1
+    if path.endswith(("experts.w_gate", "experts.w_up")):
+        return 3  # [L, E, D, F] -> shard F
+    if path.endswith("experts.w_down"):
+        return 2  # [L, E, F, D] -> shard F
+    if path == "lm_head":
+        return 1  # vocab-parallel logits
+    return None
+
+
 def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
     """Ragged chunked forward (reference inference/v2/model_implementations/
-    mixtral): llama-style paged attention + no-drop top-k MoE FFN per layer."""
+    mixtral): llama-style paged attention + no-drop top-k MoE FFN per layer.
+
+    ``tp_axis``: see models/llama.py forward_paged — head counts come from the
+    local param shapes, row-parallel partials (wo, expert w_down) are psum'd."""
     from ..ops.attention.paged import paged_attention
     from .transformer import apply_rotary
 
@@ -215,10 +237,12 @@ def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, bl
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
-    H, KV = config.num_heads, config.num_kv_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads  # true head dim: TP-invariant
+    H = params["layers"]["attn"]["wq"].shape[-1] // Dh   # local (per-shard) heads
+    KV = params["layers"]["attn"]["wk"].shape[-1] // Dh
     scale = 1.0 / np.sqrt(Dh)
     head_idx = jnp.arange(KV)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -232,14 +256,16 @@ def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, bl
         vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
         out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
                               block_size=block_size, softmax_scale=scale)
-        x = x + out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype)
+        x = x + preduce(out.reshape(b, tchunk, H * Dh) @ lp["attn"]["wo"].astype(x.dtype))
         moe_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
         flat = moe_in.reshape(b * tchunk, config.hidden_size)
-        moe_out = dense_moe_ffn(lp["moe"], flat, config.top_k)
+        moe_out = preduce(dense_moe_ffn(lp["moe"], flat, config.top_k))
         x = x + moe_out.reshape(b, tchunk, config.hidden_size)
         return x, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     logits = x @ params["lm_head"].astype(x.dtype)
+    if tp_axis is not None and gather_logits:
+        logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
     return logits, {"k": new_k, "v": new_v}
