@@ -62,7 +62,8 @@ def initialize(args=None,
     if tp_rules is None and model is not None:
         tp_rules = getattr(model, "tp_rules", None)
     engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules,
-                    param_init_fn=param_init_fn)
+                    param_init_fn=param_init_fn,
+                    layer_fn=kwargs.pop("layer_fn", None), head_fn=kwargs.pop("head_fn", None))
 
     dataloader = None
     if training_data is not None:

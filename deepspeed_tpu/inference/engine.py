@@ -70,7 +70,11 @@ class InferenceEngine:
             params = jax.tree_util.tree_map(
                 lambda x: x if is_woq_leaf(x) else jnp.asarray(x, self.dtype),
                 params, is_leaf=is_woq_leaf)
-            self.params = jax.device_put(params)
+            # replicate over the WHOLE topology mesh: a bare device_put would
+            # leave packed leaves committed to the default device only, and the
+            # jitted forward then fails (or silently serves one chip) when
+            # combined with mesh-placed cache/inputs
+            self.params = jax.device_put(params, self.topology.replicated())
         else:
             self.params = self._shard_params(params)
         self._prefill = None

@@ -109,6 +109,9 @@ def decode_world_info(encoded: str) -> Dict[str, int]:
 class MultiNodeRunner:
     """Base remote runner (reference multinode_runner.py:18)."""
     name = "base"
+    # env for the spawned launcher process; None = inherit os.environ.  Set by
+    # runners whose transport can't inline every variable (Slurm comma values).
+    spawn_env: Optional[Dict[str, str]] = None
 
     def __init__(self, args, world_info: Dict[str, int]):
         self.args = args
@@ -225,7 +228,15 @@ class SlurmRunner(MultiNodeRunner):
 
     def get_cmd(self, environment, active_resources):
         total = len(active_resources)
-        exports = "ALL," + ",".join(f"{k}={v}" for k, v in sorted(environment.items()))
+        # --export splits on commas with no escape syntax: a comma-containing
+        # value inlined as K=V would silently corrupt every later pair.  Those
+        # values ride the parent environment instead — srun forwards it under
+        # the leading ALL (the launcher spawns srun with os.environ inherited).
+        inline, via_parent = {}, {}
+        for k, v in sorted(environment.items()):
+            (via_parent if "," in str(v) else inline)[k] = str(v)
+        self.spawn_env = {**os.environ, **via_parent} if via_parent else None
+        exports = "ALL" + "".join(f",{k}={v}" for k, v in inline.items())
         cmd = ["srun", "-n", str(total)]
         if active_resources:
             cmd += ["-w", ",".join(active_resources.keys())]
@@ -325,7 +336,7 @@ def main(argv=None):
         return rc
     cmd = runner.get_cmd(env, resources)
     logger.info(f"launching: {' '.join(cmd)}")
-    return subprocess.call(cmd)
+    return subprocess.call(cmd, env=runner.spawn_env)
 
 
 if __name__ == "__main__":

@@ -77,7 +77,8 @@ def _block(config: FalconConfig, lp, x, cos, sin, attention_fn=None):
     k = apply_rotary(k, cos, sin)
     attn = (attention_fn or sdpa)(q, k, v, causal=True)
     attn_out = attn.reshape(b, s, H * Dh) @ lp["wo"].astype(x.dtype)
-    mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype), approximate=True) @ lp["fc2"].astype(x.dtype)
+    # HF Falcon's 'gelu' is the exact erf form, not tanh (phi's gelu_new IS tanh)
+    mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype), approximate=False) @ lp["fc2"].astype(x.dtype)
     return x + attn_out + mlp_out  # parallel residual
 
 
@@ -140,7 +141,7 @@ def forward_paged(config: FalconConfig, params, tokens, n_tokens, start_pos, blo
                               block_size=block_size, softmax_scale=scale)
         attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)
         mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype),
-                              approximate=True) @ lp["fc2"].astype(x.dtype)
+                              approximate=False) @ lp["fc2"].astype(x.dtype)
         return x + attn_out + mlp_out, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))

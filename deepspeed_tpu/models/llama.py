@@ -84,15 +84,18 @@ def init_params(config: LlamaConfig, key, dtype=jnp.float32):
 
 
 def _layer_fn(config: LlamaConfig, cos, sin, attention_fn=None):
+    from ..runtime.activation_checkpointing import checkpoint_name
 
     def layer(x, layer_params):
         attn_in = rms_norm(x, layer_params["attn_norm"], config.rms_eps)
         attn_out, _ = attention_block(layer_params["attn"], attn_in,
                                       n_heads=config.num_heads, n_kv_heads=config.num_kv_heads,
                                       cos=cos, sin=sin, causal=True, attention_fn=attention_fn)
-        x = x + attn_out
+        # residual-stream names: identity unless an offload/naming remat policy
+        # targets them (runtime/activation_checkpointing.py RESIDUAL_NAMES)
+        x = checkpoint_name(x + attn_out, "attn_resid")
         mlp_in = rms_norm(x, layer_params["mlp_norm"], config.rms_eps)
-        x = x + swiglu_mlp(layer_params["mlp"], mlp_in)
+        x = checkpoint_name(x + swiglu_mlp(layer_params["mlp"], mlp_in), "mlp_resid")
         return x, None
 
     return layer
@@ -104,8 +107,8 @@ def forward(config: LlamaConfig, params, input_ids, attention_fn=None):
     x = params["embed"][input_ids]  # keep embed dtype (engine casts params)
     layer = _layer_fn(config, cos, sin, attention_fn)
     if config.remat:
-        policy = getattr(jax.checkpoint_policies, config.remat_policy, None) if config.remat_policy else None
-        layer = jax.checkpoint(layer, policy=policy)
+        from ..runtime.activation_checkpointing import resolve_policy
+        layer = jax.checkpoint(layer, policy=resolve_policy(config.remat_policy))
     x, _ = jax.lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]

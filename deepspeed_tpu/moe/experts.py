@@ -9,7 +9,12 @@ inference gets from CUTLASS moe_gemm, inference/v2/kernels/cutlass_ops/moe_gemm)
 import jax
 import jax.numpy as jnp
 
-from ..models.transformer import init_linear
+
+def init_linear(key, in_dim, out_dim, dtype=jnp.float32):
+    """Fan-in normal init, identical to models.transformer.init_linear
+    (duplicated 2 lines instead of imported: models/__init__ pulls in mixtral,
+    which imports this module — a cycle when deepspeed_tpu.moe loads first)."""
+    return jax.random.normal(key, (in_dim, out_dim), dtype) * (1.0 / jnp.sqrt(jnp.float32(in_dim)))
 
 
 def init_swiglu_experts(key, num_experts: int, model_dim: int, hidden_dim: int, dtype=jnp.float32):

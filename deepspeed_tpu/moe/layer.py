@@ -31,6 +31,7 @@ class MoE:
                  capacity_factor: float = 1.0,
                  eval_capacity_factor: float = 1.0,
                  min_capacity: int = 4,
+                 use_residual: bool = False,
                  noisy_gate_policy: Optional[str] = None,
                  drop_tokens: bool = True,
                  expert_kind: str = "swiglu"):
@@ -41,6 +42,7 @@ class MoE:
         self.ffn_dim = expert_intermediate_size or 4 * hidden_size
         self.num_experts = num_experts
         self.ep_size = ep_size
+        self.use_residual = use_residual
         self.expert_kind = expert_kind
         self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor, eval_capacity_factor,
                              min_capacity, noisy_gate_policy, drop_tokens)
@@ -52,13 +54,34 @@ class MoE:
             self._expert_fn = experts_lib.gelu_experts
 
     def init(self, key, dtype=jnp.float32):
-        k_gate, k_exp = jax.random.split(key)
-        return {
+        k_gate, k_exp, k_res, k_coef = jax.random.split(key, 4)
+        params = {
             "gate": self.gate.init(k_gate, dtype=dtype),
             "experts": self._init_experts(k_exp, self.num_experts, self.hidden_size, self.ffn_dim, dtype=dtype),
         }
+        if self.use_residual:
+            # PR-MoE (reference moe/layer.py:77-85, arXiv:2201.05596): a dense
+            # expert-shaped MLP on every token + a learned 2-way mixing head
+            params["residual_mlp"] = self._init_experts(k_res, 1, self.hidden_size,
+                                                        self.ffn_dim, dtype=dtype)
+            params["coefficient"] = {
+                "w": jax.random.normal(k_coef, (self.hidden_size, 2), dtype) * 0.02,
+                "b": jnp.zeros((2, ), dtype),
+            }
+        return params
 
     def __call__(self, params, x, train: bool = True, rng=None, topo: Optional[MeshTopology] = None):
         """x [..., hidden] -> (out, l_aux)."""
-        return moe_layer(self.gate, params, x, expert_fn=self._expert_fn, train=train, rng=rng,
-                         ep_axis=EXPERT_AXIS, topo=topo)
+        out, l_aux = moe_layer(self.gate, params, x, expert_fn=self._expert_fn, train=train,
+                               rng=rng, ep_axis=EXPERT_AXIS, topo=topo)
+        if self.use_residual:
+            # Residual MoE combine (reference moe/layer.py:118-126): softmax'd
+            # per-token coefficients weight expert output vs the dense MLP
+            flat = x.reshape(-1, self.hidden_size)
+            mlp_out = self._expert_fn(params["residual_mlp"], flat[None])[0].reshape(x.shape)
+            coef = jax.nn.softmax(
+                (x @ params["coefficient"]["w"].astype(x.dtype)
+                 + params["coefficient"]["b"].astype(x.dtype)).astype(jnp.float32),
+                axis=-1).astype(x.dtype)
+            out = out * coef[..., 0:1] + mlp_out * coef[..., 1:]
+        return out, l_aux

@@ -1,0 +1,85 @@
+"""Activation checkpointing (remat) subsystem.
+
+Analog of the reference's activation_checkpointing/checkpointing.py: the
+reference wraps module calls in CheckpointFunction (:484) and offers two memory
+levers beyond plain recompute — ``partition_activations`` (:373, saved
+activations sharded over model-parallel ranks) and ``cpu_checkpointing`` (:470,
+saved activations moved to host RAM).  Under XLA the first is what GSPMD
+already does to saved residuals of sharded activations; the second maps to
+JAX's offload remat policies, which annotate chosen residuals to live in
+``pinned_host`` memory between forward and backward (the Infinity-style
+HBM-relief lever).
+
+Policies by name (model configs carry a string; see models/llama.py
+``remat_policy``):
+
+  everything_saveable / nothing_saveable / dots_saveable /
+  dots_with_no_batch_dims_saveable        jax built-ins (recompute trade-offs)
+  offload_dot                             matmul outputs offloaded to host
+  offload_residuals / cpu_checkpointing   the named residual stream offloaded
+                                          to host; everything else recomputed
+
+Residual names are planted with ``checkpoint_name`` in the model layers
+(identity unless a naming policy is active) — llama tags its two residual-add
+outputs ``attn_resid`` / ``mlp_resid``.
+
+Composition caveat: the offload policies annotate buffers with
+``annotate_device_placement`` custom calls that (as of jax 0.9) carry no
+sharding metadata, so the GSPMD partitioner rejects them inside a multi-device
+jit.  Use them as a per-device HBM lever (single-chip or under shard_map where
+the annotated values are replicated); the plain recompute policies compose
+with every mesh.
+"""
+
+from typing import Iterable, Optional
+
+import jax
+from jax.ad_checkpoint import checkpoint_name  # re-export for models
+
+# Residual-stream names models plant; the offload policy targets these.
+RESIDUAL_NAMES = ("attn_resid", "mlp_resid")
+
+
+def resolve_policy(name: Optional[str], offload_names: Iterable[str] = RESIDUAL_NAMES,
+                   offload_dst: str = "pinned_host"):
+    """Map a config policy name to a jax.checkpoint policy.
+
+    None/"" -> None, which under jax.checkpoint means FULL recompute (save
+    nothing) — jax's default; "everything_saveable" resolves to the real
+    save-all policy via getattr below."""
+    if name in (None, ""):
+        return None
+    if name == "offload_dot":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims("device", offload_dst)
+    if name in ("offload_residuals", "cpu_checkpointing"):
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(offload_names),
+            offload_src="device", offload_dst=offload_dst)
+    if name == "save_anything_except_these_names":
+        # factory name from the config surface: except the planted residuals
+        return jax.checkpoint_policies.save_anything_except_these_names(*offload_names)
+    # only true policies may fall through — the other jax.checkpoint_policies
+    # attributes are FACTORIES, which jax.checkpoint would silently accept and
+    # then treat every primitive as saveable (remat disabled)
+    direct = ("everything_saveable", "nothing_saveable", "dots_saveable",
+              "dots_with_no_batch_dims_saveable", "checkpoint_dots",
+              "checkpoint_dots_with_no_batch_dims")
+    if name in direct:
+        return getattr(jax.checkpoint_policies, name)
+    raise ValueError(f"unknown remat policy {name!r}; known: {', '.join(direct)}, "
+                     f"offload_dot, offload_residuals, save_anything_except_these_names")
+
+
+def policy_from_config(cfg) -> Optional[object]:
+    """ActivationCheckpointingConfig -> policy; ``cpu_checkpointing: true``
+    selects the host-offload policy exactly like the reference's config gate
+    (checkpointing.py:470 + config key)."""
+    if cfg.cpu_checkpointing:
+        return resolve_policy("offload_residuals")
+    return resolve_policy(cfg.policy)
+
+
+def checkpoint(fn, policy_name: Optional[str] = "nothing_saveable", **kwargs):
+    """jax.checkpoint with a by-name policy (CheckpointFunction analog)."""
+    return jax.checkpoint(fn, policy=resolve_policy(policy_name), **kwargs)

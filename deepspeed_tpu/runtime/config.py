@@ -116,7 +116,8 @@ class ActivationCheckpointingConfig(ConfigModel):
     policy: str = Field("nothing_saveable",
                         choices=("everything_saveable", "nothing_saveable", "dots_saveable",
                                  "dots_with_no_batch_dims_saveable", "checkpoint_dots",
-                                 "save_anything_except_these_names", "offload_dot"))
+                                 "save_anything_except_these_names", "offload_dot",
+                                 "offload_residuals"))
 
 
 class OptimizerConfig(ConfigModel):

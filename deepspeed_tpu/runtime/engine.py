@@ -86,7 +86,9 @@ class Engine:
                  topology: Optional[MeshTopology] = None,
                  dp_world_size: Optional[int] = None,
                  tp_rules=None,
-                 param_init_fn: Optional[Callable] = None):
+                 param_init_fn: Optional[Callable] = None,
+                 layer_fn: Optional[Callable] = None,
+                 head_fn: Optional[Callable] = None):
         self.config = config
         self.loss_fn = loss_fn
         self.topology = topology or MeshTopology.build(_mesh_config_for(config))
@@ -137,14 +139,44 @@ class Engine:
         self._compiled_step = None
         self._compiled_eval = None
 
+        act_cfg = config.activation_checkpointing
+        if act_cfg.cpu_checkpointing or act_cfg.policy != "nothing_saveable":
+            # remat is owned by the MODEL under the functional contract (the
+            # loss_fn closes over jax.checkpoint) — same loud requested-but-
+            # engine-cannot-apply pattern as the hpZ/qwZ knobs
+            log_dist(
+                f"activation_checkpointing requests policy="
+                f"{'offload_residuals (cpu_checkpointing)' if act_cfg.cpu_checkpointing else act_cfg.policy}: "
+                f"apply it in the model config (e.g. LlamaConfig.remat_policy) or via "
+                f"runtime.activation_checkpointing.policy_from_config — the engine cannot "
+                f"rewrite remat inside an opaque loss_fn", ranks=[0])
         off = config.zero_optimization.offload_optimizer
         self.offload_device = off.device if (off is not None and off.device != "none") else None
         off_p = config.zero_optimization.offload_param
+        self._nvme_trainer = None
         if off_p is not None and off_p.device == "nvme":
-            raise NotImplementedError(
-                "offload_param: nvme needs the layer structure the opaque loss_fn hides — "
-                "use runtime.swap_tensor.partitioned_param_swapper.SwappedLayerTrainer "
-                "(the ZeRO-Infinity layer-streaming path) for NVMe-resident parameters")
+            # ZeRO-Infinity param streaming from config alone (reference
+            # partition_parameters.py:1479 + swapper wiring): the engine builds
+            # the SwappedLayerTrainer when the caller supplies the layer
+            # structure an opaque loss_fn hides.
+            if layer_fn is None or head_fn is None:
+                raise ValueError(
+                    "offload_param: nvme streams one layer at a time, which needs the layer "
+                    "structure the opaque loss_fn hides — pass layer_fn(params_l, x) -> x and "
+                    "head_fn(head_params, x, labels) -> loss to initialize(), with "
+                    "model_parameters = {'layers': stacked [L, ...] tree, ...head leaves} "
+                    "(ZeRO-Infinity layer streaming, ref partition_parameters.py:1479)")
+            if not (isinstance(params, dict) and "layers" in params):
+                raise ValueError("offload_param: nvme expects model_parameters to be a dict "
+                                 "with a stacked 'layers' subtree ([L, ...] leaves)")
+            if self.gradient_accumulation_steps != 1 or self.dp_world_size != 1:
+                raise ValueError(
+                    f"offload_param: nvme streams layers on ONE process/device "
+                    f"(gas={self.gradient_accumulation_steps}, dp={self.dp_world_size} "
+                    f"requested) — set gradient_accumulation_steps=1 and a single-device "
+                    f"topology; scale-out composes via the launcher, one trainer per host")
+            self._init_nvme_trainer(params, off_p, layer_fn, head_fn)
+            return
         abstract = any(isinstance(p, jax.ShapeDtypeStruct) for p in jax.tree_util.tree_leaves(params))
         if abstract and param_init_fn is None:
             raise ValueError("model_parameters is abstract (ShapeDtypeStruct leaves); "
@@ -242,6 +274,26 @@ class Engine:
         )
 
     # ------------------------------------------------- optimizer offload path
+    def _init_nvme_trainer(self, params, off_p, layer_fn, head_fn):
+        """Config-reachable ZeRO-Infinity param path (reference reaches the
+        AsyncPartitionedParameterSwapper from offload_param: nvme alone,
+        partition_parameters.py:1479)."""
+        import tempfile
+
+        from .swap_tensor.partitioned_param_swapper import (AsyncPartitionedParameterSwapper,
+                                                            SwappedLayerTrainer)
+        path = off_p.nvme_path or tempfile.mkdtemp(prefix="dstpu_nvme_")
+        swapper = AsyncPartitionedParameterSwapper(path, buffer_count=off_p.buffer_count)
+        stacked = params["layers"]
+        num_layers = int(np.shape(jax.tree_util.tree_leaves(stacked)[0])[0])
+        trainer = SwappedLayerTrainer(layer_fn, num_layers, head_fn, swapper,
+                                      lr=self.base_lr, compute_dtype=self.compute_dtype)
+        trainer.init_from_stacked(stacked, {k: v for k, v in params.items() if k != "layers"})
+        self._nvme_trainer = trainer
+        self.state = None
+        log_dist(f"Engine: ZeRO-Infinity NVMe param streaming — {num_layers} layers, "
+                 f"buffer_count={off_p.buffer_count}, path={path}", ranks=[0])
+
     def _init_offload(self, params, off_cfg):
         """ZeRO-Offload/Infinity analog (reference swap_tensor + cpu_adam): fp32
         master + Adam moments live on host (cpu) or disk (nvme); the device
@@ -408,9 +460,6 @@ class Engine:
         onebit_fn = None
         if self._onebit is not None and self._onebit_world > 1:
             onebit_fn = self._make_onebit_step()
-            if clip_norm > 0:
-                log_dist("gradient_clipping is not applied on the 1-bit compressed "
-                         "path (reference onebit optimizers skip it)", ranks=[0])
 
         def train_step(state: TrainState, batch) -> Tuple[TrainState, StepMetrics]:
             rng, step_rng = jax.random.split(state.rng)
@@ -509,13 +558,25 @@ class Engine:
             spec = error_buffer_spec(path, ax)
             return spec if spec is not None else rep
 
+        clip_norm = self.config.gradient_clipping
+
         def body(master, opt_state, batch, micro_rngs, lr):
             params16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), master)
             grads, loss_sum = accumulate_micro_grads(loss_fn, params16, batch, micro_rngs,
                                                      jnp.float32(1.0))
             grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
-            # approximate norm: mean over ranks of the local-grad global norm
-            norm = jax.lax.pmean(global_grad_norm(grads), ax)
+            # global norm from ONE scalar psum of squared local norms (no full
+            # gradient allreduce — that would defeat the 1-bit compression):
+            # normalized by world so it equals the exact global norm when rank
+            # grads coincide (post-allreduce semantics); identical on every
+            # rank, so the clip factor below is consistent
+            sq = global_grad_norm(grads) ** 2
+            norm = jnp.sqrt(jax.lax.psum(sq, ax) / world)
+            if clip_norm > 0:
+                # clip BEFORE the momentum update, like the fp16 optimizer path
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.minimum(1.0, clip_norm / (norm + 1e-6)).astype(g.dtype),
+                    grads)
             new_master, new_opt = spec.local_step(grads, opt_state, master, lr, ax, world)
             return new_master, new_opt, jax.lax.pmean(loss_sum, ax), norm
 
@@ -554,6 +615,20 @@ class Engine:
         ``batch``: pytree with leaves shaped [train_batch_size, ...] or
         [gas, micro*dp, ...]; reshaped/sharded automatically.
         """
+        if self._nvme_trainer is not None:
+            # ZeRO-Infinity layer streaming: one layer (+ its Adam state) on
+            # device / in host buffers at a time; batch passes through whole
+            self.throughput.start()
+            lr = float(self.lr_schedule(self.global_steps))
+            loss = self._nvme_trainer.train_step(batch, lr=lr)
+            metrics = StepMetrics(loss=jnp.float32(loss), grad_norm=jnp.float32(0.0),
+                                  lr=jnp.float32(lr), skipped=jnp.asarray(False),
+                                  loss_scale=jnp.float32(1.0))
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size
+            self.lr_scheduler.last_step = self.global_steps
+            self._maybe_report(metrics)
+            return metrics
         breakdown = self.config.wall_clock_breakdown
         t0 = time.perf_counter() if breakdown else 0.0
         batch = self._ensure_gas_layout(batch)
@@ -617,7 +692,16 @@ class Engine:
         self._micro_batches = []
         return self.train_batch(stacked)
 
+    def _nvme_guard(self, what: str):
+        if self._nvme_trainer is not None:
+            raise NotImplementedError(
+                f"{what} is not available on the offload_param:nvme streaming path — state "
+                f"lives in the swapper's NVMe files (persistent across runs at nvme_path); "
+                f"use the trainer's forward() for inference, and point a new engine at the "
+                f"same nvme_path to resume")
+
     def eval_batch(self, batch, rng=None):
+        self._nvme_guard("eval_batch")
         if self._compiled_eval is None:
             compute_dtype = self.compute_dtype
 
@@ -679,6 +763,7 @@ class Engine:
             logger.warning(msg)
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None):
+        self._nvme_guard("save_checkpoint")
         tag = tag or f"global_step{self.global_steps}"
         self._validate_tag(tag)
         client_state = dict(client_state or {})
@@ -706,6 +791,7 @@ class Engine:
                 "opt_state": {"step": np.int32(sd["step"]), "exp_avg": m, "exp_avg_sq": v}}
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
+        self._nvme_guard("load_checkpoint")
         if self.offload_device is not None:
             return self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
         state, client_state = load_checkpoint_dir(load_dir,

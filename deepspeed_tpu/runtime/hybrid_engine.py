@@ -27,7 +27,7 @@ class DeepSpeedHybridEngine(Engine):
     """
 
     def __init__(self, *args, model_module=None, model_config=None,
-                 inference_config: Optional[Dict] = None, **kwargs):
+                 inference_config: Optional[Dict] = None, lora_params=None, **kwargs):
         super().__init__(*args, **kwargs)
         if model_module is None:
             raise ValueError("DeepSpeedHybridEngine needs model_module (and model_config)")
@@ -37,14 +37,68 @@ class DeepSpeedHybridEngine(Engine):
         self._inf_cfg.setdefault("dtype", "bfloat16" if self.compute_dtype == jnp.bfloat16 else "float32")
         self._inf_engine: Optional[InferenceEngine] = None
         self._params_version = -1
+        self._lora = lora_params
+        self._lora_fused = lora_params is not None
         log_dist("HybridEngine: training + rollout generation enabled", ranks=[0])
+
+    # --------------------------------------------------------------- LoRA
+    def set_lora(self, lora_params) -> None:
+        """Attach LoRA adapters (reference hybrid_engine.py:138-158 fuse/unfuse).
+
+        ``lora_params`` mirrors the base param tree on the adapted subset; each
+        adapted leaf is ``{"a": [..., in, r], "b": [..., r, out], "alpha": s}``
+        (stacked-layer leaves carry the leading L dim on a/b too).  Generation
+        serves ``W + (alpha/r) a @ b`` — fused on device into the SAME compiled
+        prefill/decode programs (shapes unchanged, so no recompilation); the
+        train step keeps seeing the unfused base params.
+        """
+        self._lora = lora_params
+        self._lora_fused = lora_params is not None
+        self._params_version = -1  # force a weight refresh on next generate
+
+    def fuse_lora_weight(self) -> None:
+        """API parity with the reference's explicit fuse (hybrid_engine.py:145)."""
+        self._ensure_lora_toggle(True)
+
+    def unfuse_lora_weight(self) -> None:
+        """Serve the base weights again (reference :152)."""
+        self._ensure_lora_toggle(False)
+
+    def _ensure_lora_toggle(self, fused: bool):
+        if self._lora is None:
+            raise ValueError("no LoRA adapters attached — call set_lora first")
+        if self._lora_fused != fused:
+            self._lora_fused = fused
+            self._params_version = -1
+
+    @staticmethod
+    def _fuse_lora_tree(params, lora):
+        """Return params with ``W + (alpha/r) a @ b`` applied on the adapted
+        subset (functional: the base tree is never mutated, so 'unfuse' is
+        simply serving the originals)."""
+        def fuse(p, l):
+            if l is None:
+                return p
+            if isinstance(l, dict) and "a" in l and "b" in l:
+                a = jnp.asarray(l["a"], p.dtype)
+                b = jnp.asarray(l["b"], p.dtype)
+                scale = jnp.asarray(float(l.get("alpha", a.shape[-1])) / a.shape[-1], p.dtype)
+                return p + jnp.einsum("...ir,...ro->...io", a, b) * scale
+            if isinstance(l, dict):
+                return {k: fuse(p[k], l.get(k)) for k in p} if isinstance(p, dict) else p
+            return p
+        return fuse(params, lora)
 
     # ------------------------------------------------------------- the flip
     def _current_params16(self):
         if self.offload_device is not None:
-            return self._compute_params
-        cast = jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype), self.state.params)
-        return cast
+            params = self._compute_params
+        else:
+            params = jax.tree_util.tree_map(lambda x: x.astype(self.compute_dtype),
+                                            self.state.params)
+        if self._lora is not None and self._lora_fused:
+            params = self._fuse_lora_tree(params, self._lora)
+        return params
 
     def _refresh_inference(self):
         if self._inf_engine is None:

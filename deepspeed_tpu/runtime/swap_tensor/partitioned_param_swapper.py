@@ -203,9 +203,12 @@ class SwappedLayerTrainer:
         # ---- forward: stream 0..L-1, double-buffered prefetch
         self.swapper.swap_in_async(self._pkey(0))
         for l in range(self.num_layers):
-            if l + 1 < self.num_layers and self.swapper.available_swap_in_buffers() > 0:
-                self.swapper.swap_in_async(self._pkey(l + 1))
+            # wait FIRST so layer l's buffer is the one recycled; prefetch l+1
+            # unconditionally — it overlaps this layer's compute, and gating on
+            # free buffers made layer 1's read synchronous every step
             host = self.swapper.wait_in(self._pkey(l))
+            if l + 1 < self.num_layers:
+                self.swapper.swap_in_async(self._pkey(l + 1))
             saved_inputs[l] = np.asarray(x)  # activation checkpoint on host
             x = self._fwd_jit(self._device_params(host), x)
             self.swapper.release(self._pkey(l))
@@ -226,9 +229,9 @@ class SwappedLayerTrainer:
 
         # ---- backward: stream L-1..0, recompute layer fwd, step immediately
         for l in reversed(range(self.num_layers)):
-            if l - 1 >= 0 and self.swapper.available_swap_in_buffers() > 0:
-                self.swapper.swap_in_async(self._pkey(l - 1))
             host = self.swapper.wait_in(self._pkey(l))
+            if l - 1 >= 0:
+                self.swapper.swap_in_async(self._pkey(l - 1))
             params_dev = self._device_params(host)
             x_in = jnp.asarray(saved_inputs[l], self.compute_dtype)
             dparams, dx = self._bwd_jit(params_dev, x_in, dx.astype(self.compute_dtype))
@@ -262,9 +265,9 @@ class SwappedLayerTrainer:
         x = jnp.asarray(x, self.compute_dtype)
         self.swapper.swap_in_async(self._pkey(0))
         for l in range(self.num_layers):
-            if l + 1 < self.num_layers and self.swapper.available_swap_in_buffers() > 0:
-                self.swapper.swap_in_async(self._pkey(l + 1))
             host = self.swapper.wait_in(self._pkey(l))
+            if l + 1 < self.num_layers:
+                self.swapper.swap_in_async(self._pkey(l + 1))
             x = self._fwd_jit(self._device_params(host), x)
             self.swapper.release(self._pkey(l))
         return x

@@ -28,6 +28,7 @@ def tiny_params(tiny_cfg):
     return llama.init_params(tiny_cfg, jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_cache_forward_matches_full(tiny_cfg, tiny_params):
     """Prefill+decode through the cache == one full forward (numerics)."""
     ids = np.random.default_rng(0).integers(0, tiny_cfg.vocab_size, (2, 16))
@@ -89,6 +90,7 @@ def test_quantized_weights_close(tiny_cfg, tiny_params):
     assert np.corrcoef(lr.ravel(), lq.ravel())[0, 1] > 0.999
 
 
+@pytest.mark.slow
 def test_hf_llama_parity():
     """from_hf_state_dict + forward matches transformers' torch forward."""
     torch = pytest.importorskip("torch")

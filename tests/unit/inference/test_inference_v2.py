@@ -115,6 +115,7 @@ def test_paged_attention_kernel_parity():
 
 
 # ------------------------------------------------------------- mistral v2
+@pytest.mark.slow
 def test_mistral_v2_ragged_consistent_and_windowed():
     """Mistral serves through v2 with the window applied: ragged multi-seq
     generation == one-seq-at-a-time generation (scheduling invariance)."""

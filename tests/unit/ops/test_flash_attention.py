@@ -36,6 +36,7 @@ def test_forward_matches_sdpa(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_gqa_forward():
     q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 32, 8, 2, 16)
     out = flash.flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
@@ -51,6 +52,7 @@ def test_unaligned_seq_padding():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_backward_matches_sdpa(causal):
     q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 32, 4, 2, 16)
@@ -68,6 +70,7 @@ def test_backward_matches_sdpa(causal):
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.slow
 def test_unaligned_seq_backward_no_nan():
     # regression: padded lse rows used to poison dk/dv with NaN when S % block != 0
     q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 40, 2, 2, 16)

@@ -56,6 +56,7 @@ def test_quantized_allgather():
                                atol=0.1, rtol=0.1)
 
 
+@pytest.mark.slow
 def test_quantized_reduce_scatter_int4():
     devices = np.array(jax.devices()[:8])
     mesh = Mesh(devices, ("dp", ))

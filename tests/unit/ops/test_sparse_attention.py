@@ -143,6 +143,7 @@ def test_kernel_matches_dense_fixed(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_kernel_matches_dense_bigbird_gqa():
     random.seed(11)
     cfg = BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=1,
@@ -164,6 +165,7 @@ def test_kernel_handles_unpadded_seq():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_gradients_match_dense():
     cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
                               attention="unidirectional")

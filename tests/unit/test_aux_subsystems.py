@@ -95,6 +95,7 @@ def test_init_compression_targets_modules():
 
 
 # ----------------------------------------------------------------- 1-bit comm
+@pytest.mark.slow
 def test_onebit_allreduce_error_feedback_converges():
     mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
     n = 1024

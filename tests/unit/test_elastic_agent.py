@@ -25,6 +25,7 @@ def test_clean_run_exits_zero(tmp_path):
     assert agent.restart_count == 0
 
 
+@pytest.mark.slow
 def test_failure_rescales_and_recovers(tmp_path):
     """Workers fail while a flag file is present (simulated lost capacity at
     world=4); the agent drops to the next valid size and succeeds."""
@@ -45,6 +46,7 @@ def test_failure_rescales_and_recovers(tmp_path):
     assert agent.restart_count == 1
 
 
+@pytest.mark.slow
 def test_restart_budget_exhausted(tmp_path):
     agent = DSElasticAgent([sys.executable, "-c", "import sys; sys.exit(7)"],
                            world_size=2, elastic_config=ELASTIC,
@@ -53,6 +55,7 @@ def test_restart_budget_exhausted(tmp_path):
     assert agent.restart_count == 1
 
 
+@pytest.mark.slow
 def test_initial_world_clamped_to_valid():
     """world_size not permitted by the elastic config clamps before launch."""
     import os

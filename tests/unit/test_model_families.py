@@ -16,6 +16,7 @@ FAMILIES = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mod,cfg", FAMILIES, ids=lambda f: getattr(f, "__name__", ""))
 def test_forward_and_grads(mod, cfg):
     params = mod.init_params(cfg, jax.random.PRNGKey(0))
@@ -73,6 +74,7 @@ def test_paged_decode_step(mod, cfg):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_engine_trains_each_family(mesh8):
     """Every family plugs into deepspeed_tpu.initialize and the loss drops."""
     import deepspeed_tpu

@@ -86,6 +86,7 @@ def test_llama_hf_parity():
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_gpt2_trains_with_engine():
     cfg = gpt2.GPT2Config.tiny()
     params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
@@ -121,6 +122,7 @@ def test_bert_mlm_forward_and_mask():
     np.testing.assert_allclose(np.asarray(logits[1, :8]), np.asarray(l2[1, :8]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_trains_zero1():
     cfg = bert.BertConfig.tiny()
     params = bert.init_params(cfg, jax.random.PRNGKey(0))

@@ -87,3 +87,49 @@ def test_onebit_serial_single_device():
     got, _ = _train(_cfg("onebitadam", {"freeze_step": 8}), topo, steps=12)
     assert all(np.isfinite(got))
     assert got[7] < got[0]  # warmup converged; compressed steps stay finite
+
+
+def test_onebit_grad_norm_is_global(mesh8):
+    """The reported grad_norm is the psum'd global statistic
+    sqrt(sum_r ||g_r||^2 / world), not a pmean of local norms — identical on
+    every rank and exact when rank grads coincide (VERDICT r2: engine 1-bit
+    path norm fix; reference fp16 optimizers compute a true global norm)."""
+    cfg = _cfg("onebitadam", {"freeze_step": 100})
+    cfg["bf16"] = {"enabled": False}
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=64, nlayers=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, model_parameters=params, topology=mesh8, config=cfg)
+    batch = random_batch(engine.train_batch_size, 64, seed=5)
+    m = engine.train_batch(batch)
+
+    from deepspeed_tpu.runtime.optimizers import global_grad_norm
+    micro = 2
+    sq = []
+    for r in range(8):
+        sl = {k: v[r * micro:(r + 1) * micro] for k, v in batch.items()}
+        g = jax.grad(lambda p: mlp_loss_fn(p, sl, jax.random.PRNGKey(0)))(params)
+        sq.append(float(global_grad_norm(g)) ** 2)
+    expect = np.sqrt(np.mean(sq))
+    np.testing.assert_allclose(float(m.grad_norm), expect, rtol=1e-4)
+
+
+def test_onebit_clipping_shrinks_update(mesh8):
+    """gradient_clipping now applies on the 1-bit path (clip before the
+    momentum update) instead of being log-only skipped."""
+    def delta(clip):
+        cfg = _cfg("onebitadam", {"freeze_step": 100})
+        cfg["bf16"] = {"enabled": False}
+        if clip is not None:
+            cfg["gradient_clipping"] = clip
+        params = init_mlp_params(jax.random.PRNGKey(0), hidden=64, nlayers=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            loss_fn=mlp_loss_fn, model_parameters=params, topology=mesh8, config=cfg)
+        before = [np.asarray(l).copy() for l in jax.tree_util.tree_leaves(engine.state.params)]
+        engine.train_batch(random_batch(engine.train_batch_size, 64, seed=5))
+        after = jax.tree_util.tree_leaves(engine.state.params)
+        return float(sum(np.sum((np.asarray(a) - b) ** 2) for a, b in zip(after, before)))
+
+    unclipped = delta(None)
+    # aggressively clipped grads vanish against Adam's eps -> tiny step
+    clipped = delta(1e-5)
+    assert clipped < unclipped * 0.1, (clipped, unclipped)

@@ -162,6 +162,7 @@ def test_fused_adam_step_fn_matches_adamw():
         assert jnp.allclose(a, b, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_engine_fused_adam_trains(mesh8):
     """optimizer.type fused_adam runs through the engine (multi-dev falls back
     to the delta path; single-dev uses the fused step) and reduces loss."""

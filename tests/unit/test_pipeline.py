@@ -342,6 +342,7 @@ def test_1f1b_eval_batch():
     assert len(outs) == 3 and outs[0].shape == (2, HIDDEN)
 
 
+@pytest.mark.slow
 def test_compiled_pipeline_tied_embedding_grads():
     """Tied embed/unembed AROUND the compiled pipeline: one differentiable
     program, so the tied gradient sums both uses with no explicit allreduce."""

@@ -86,6 +86,7 @@ def test_llama_with_ulysses_attention(seq_topo):
     np.testing.assert_allclose(base, ulysses, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_composes_with_zero3():
     """Ulysses SP x ZeRO-3 through the full engine: opt state shards over the
     sequence axis too (reference seq_data_parallel_group, engine.py:1515),
@@ -132,6 +133,7 @@ def test_ring_attention_matches_local(seq_topo):
         np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gqa_and_grads(seq_topo):
     from deepspeed_tpu.sequence.ring import ring_attention
     rng = np.random.default_rng(8)
@@ -152,6 +154,7 @@ def test_ring_attention_gqa_and_grads(seq_topo):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_llama_trains_with_ring_attention():
     """End-to-end: ring-attention llama trains under the engine on a
     sequence=4 x data=2 mesh (long-context CP x ZeRO composition)."""

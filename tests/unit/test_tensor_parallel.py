@@ -37,6 +37,7 @@ def test_tp_params_are_sharded():
     assert "tensor" in str(wq.sharding.spec), wq.sharding.spec
 
 
+@pytest.mark.slow
 def test_tp_training_parity_with_dp_only():
     ids = np.random.default_rng(0).integers(0, 256, (8, 32))
     batch = llama.causal_lm_batch(ids)
@@ -52,6 +53,7 @@ def test_tp_training_parity_with_dp_only():
     np.testing.assert_allclose(losses_dp, losses_tp, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_tp_with_zero3():
     topo = MeshTopology.from_axis_dict({"fsdp": 2, "tensor": 4})
     cfg = llama.LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=4, kv_heads=4, seq=64)
@@ -75,6 +77,7 @@ def test_tp_with_zero3():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_mixtral_trains_with_ep():
     topo = MeshTopology.from_axis_dict({"data": 2, "expert": 4})
     cfg = mixtral.MixtralConfig.tiny(experts=4)
@@ -95,6 +98,7 @@ def test_mixtral_trains_with_ep():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_mixtral_zero_shards_over_expert_axis():
     """ZeRO states partition over the expert axis too (reference
     expert_data_parallel groups, groups.py:113): attention masters/moments are

@@ -88,6 +88,7 @@ def test_streaming_loader_bounded_host_memory(mesh8, cfg):
     assert zero.max_loader_bytes() < total / 2, (zero.max_loader_bytes(), total)
 
 
+@pytest.mark.slow
 def test_engine_abstract_init_trains(mesh8, cfg):
     """initialize() with abstract model_parameters + param_init_fn: the engine
     materializes the train state sharded and takes a normal step."""
