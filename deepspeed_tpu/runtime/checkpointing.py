@@ -186,7 +186,14 @@ def load_checkpoint_dir(load_dir: str,
         want = getattr(cur_leaf, "dtype", None)
         if want is not None and arr.dtype != want:
             arr = arr.astype(want)  # materializes; same-dtype mmap stays lazy
-        new_leaves.append(jax.device_put(arr, sharding))
+        if jax.process_count() > 1:
+            # multi-controller: eager device_put rejects shardings spanning
+            # non-addressable devices; build from per-shard callbacks instead
+            # (each process materializes only its addressable shards' pages)
+            new_leaves.append(jax.make_array_from_callback(
+                tuple(arr.shape), sharding, lambda idx, a=arr: np.asarray(a[idx])))
+        else:
+            new_leaves.append(jax.device_put(arr, sharding))
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     log_dist(f"loaded checkpoint {tag} from {ckpt_dir}", ranks=[0])
     return state, meta.get("client_state", {})

@@ -282,12 +282,22 @@ class Engine:
 
         from .swap_tensor.partitioned_param_swapper import (AsyncPartitionedParameterSwapper,
                                                             SwappedLayerTrainer)
+        opt_cfg = self.config.optimizer
+        opt_type = (opt_cfg.type if opt_cfg else "adamw").lower()
+        if opt_type not in ("adam", "adamw", "fusedadam", "fused_adam"):
+            raise ValueError(f"offload_param: nvme steps layers with the host CPU-Adam "
+                             f"(csrc/cpu_adam analog); optimizer '{opt_type}' is not supported")
+        opt_params = dict(opt_cfg.params) if opt_cfg else {}
         path = off_p.nvme_path or tempfile.mkdtemp(prefix="dstpu_nvme_")
         swapper = AsyncPartitionedParameterSwapper(path, buffer_count=off_p.buffer_count)
         stacked = params["layers"]
         num_layers = int(np.shape(jax.tree_util.tree_leaves(stacked)[0])[0])
         trainer = SwappedLayerTrainer(layer_fn, num_layers, head_fn, swapper,
-                                      lr=self.base_lr, compute_dtype=self.compute_dtype)
+                                      lr=self.base_lr,
+                                      betas=tuple(opt_params.get("betas", (0.9, 0.999))),
+                                      eps=float(opt_params.get("eps", 1e-8)),
+                                      weight_decay=float(opt_params.get("weight_decay", 0.0)),
+                                      compute_dtype=self.compute_dtype)
         trainer.init_from_stacked(stacked, {k: v for k, v in params.items() if k != "layers"})
         self._nvme_trainer = trainer
         self.state = None
@@ -574,9 +584,7 @@ class Engine:
             norm = jnp.sqrt(jax.lax.psum(sq, ax) / world)
             if clip_norm > 0:
                 # clip BEFORE the momentum update, like the fp16 optimizer path
-                grads = jax.tree_util.tree_map(
-                    lambda g: g * jnp.minimum(1.0, clip_norm / (norm + 1e-6)).astype(g.dtype),
-                    grads)
+                grads, norm = clip_by_global_norm(grads, clip_norm, precomputed_norm=norm)
             new_master, new_opt = spec.local_step(grads, opt_state, master, lr, ax, world)
             return new_master, new_opt, jax.lax.pmean(loss_sum, ax), norm
 

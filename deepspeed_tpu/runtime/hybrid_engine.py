@@ -52,9 +52,37 @@ class DeepSpeedHybridEngine(Engine):
         prefill/decode programs (shapes unchanged, so no recompilation); the
         train step keeps seeing the unfused base params.
         """
+        if lora_params is not None:
+            self._validate_lora(self.state.params if self.state is not None
+                                else self._compute_params, lora_params)
         self._lora = lora_params
         self._lora_fused = lora_params is not None
         self._params_version = -1  # force a weight refresh on next generate
+
+    @classmethod
+    def _validate_lora(cls, params, lora, path=""):
+        """Reject adapters whose paths don't exist in the base tree — a typo'd
+        key would otherwise fuse as a silent no-op and rollouts would serve the
+        unadapted policy."""
+        if lora is None:
+            return
+        if isinstance(lora, dict) and "a" in lora and "b" in lora:
+            if not hasattr(params, "shape"):
+                raise ValueError(f"LoRA adapter at {path or '<root>'} targets a non-leaf")
+            a, b = jnp.shape(lora["a"]), jnp.shape(lora["b"])
+            w = jnp.shape(params)
+            if a[:-2] + (a[-2], b[-1]) != w or a[-1] != b[-2]:
+                raise ValueError(f"LoRA shapes at {path}: a{a} @ b{b} does not match W{w}")
+            return
+        if not isinstance(lora, dict) or not isinstance(params, dict):
+            raise ValueError(f"LoRA adapter at {path or '<root>'}: expected a dict mirroring "
+                             f"the param tree (leaves = {{'a','b','alpha'}})")
+        unknown = set(lora) - set(params)
+        if unknown:
+            raise ValueError(f"LoRA adapter keys {sorted(unknown)} at {path or '<root>'} "
+                             f"not in base params (have: {sorted(params)})")
+        for k, v in lora.items():
+            cls._validate_lora(params[k], v, f"{path}.{k}" if path else k)
 
     def fuse_lora_weight(self) -> None:
         """API parity with the reference's explicit fuse (hybrid_engine.py:145)."""

@@ -347,7 +347,8 @@ def global_grad_norm(grads) -> jnp.ndarray:
 def clip_by_global_norm(grads, max_norm: float, precomputed_norm=None):
     norm = precomputed_norm if precomputed_norm is not None else global_grad_norm(grads)
     clip_coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
-    return jax.tree_util.tree_map(lambda g: g * clip_coef, grads), norm
+    # preserve each leaf's dtype (bf16 grads must not silently promote to f32)
+    return jax.tree_util.tree_map(lambda g: (g * clip_coef).astype(g.dtype), grads), norm
 
 
 def has_overflow(grads) -> jnp.ndarray:

@@ -1,0 +1,83 @@
+"""Worker body for the 2-process lane (launched by test_multiprocess.py).
+
+The reference's entire test harness runs world_size REAL ranks on one host
+(tests/unit/common.py:105 DistributedExec._launch_procs) — this is the JAX
+multi-controller analog: each worker owns 4 CPU devices, rendezvouses through
+jax.distributed, and the two controllers execute the SAME SPMD program over
+the 8-device global mesh.
+
+Run (per process): RANK, WORLD_SIZE, COORDINATOR_ADDRESS, MP_TMP in env.
+Writes "<MP_TMP>/ok.rank{R}" with result lines on success; any exception exits
+nonzero (the pytest side asserts both markers and rc==0).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.parallel import MeshTopology
+
+    rank = int(os.environ["RANK"])
+    tmp = os.environ["MP_TMP"]
+    comm.init_distributed()  # env-driven jax.distributed rendezvous
+    assert jax.process_count() == 2, jax.process_count()
+    assert comm.get_rank() == rank
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    lines = [f"devices={len(jax.devices())} local={len(jax.local_devices())}"]
+
+    # --- barrier + host collective over a global array --------------------
+    comm.barrier()
+    topo = MeshTopology.from_axis_dict({"data": 2, "fsdp": 4})
+    contrib = comm.host_broadcast(np.arange(2, dtype=np.float32)[:, None], topo)
+    red = comm.host_all_reduce(contrib, topo)
+    assert float(np.asarray(red)[0]) == 1.0, red
+    lines.append("host_all_reduce=ok")
+
+    # --- ZeRO-3 train steps over the 2-process 8-device mesh --------------
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=32, layers=2, heads=4, kv_heads=2, seq=32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=llama.make_loss_fn(cfg),
+        model_parameters=llama.init_params(cfg, jax.random.PRNGKey(0)),
+        topology=topo,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3, "param_persistence_threshold": 0},
+            "bf16": {"enabled": False},
+        })
+    # identical host batch on both controllers (SPMD contract)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (engine.train_batch_size, 32))
+    batch = llama.causal_lm_batch(ids)
+    losses = [float(engine.train_batch(batch).loss) for _ in range(2)]
+    assert all(np.isfinite(l) for l in losses), losses
+    # params really sharded across BOTH processes' devices
+    leaf = jax.tree_util.tree_leaves(engine.state.params)[1]
+    assert len(leaf.sharding.device_set) == 8
+    lines.append(f"zero3_losses={losses[0]:.6f},{losses[1]:.6f}")
+
+    # --- checkpoint save/load with tag validation across processes --------
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    tag = engine.save_checkpoint(ckpt_dir)
+    comm.barrier()
+    engine.load_checkpoint(ckpt_dir, tag)
+    post = float(engine.train_batch(batch).loss)
+    assert np.isfinite(post)
+    lines.append(f"ckpt_roundtrip_tag={tag} post_loss={post:.6f}")
+
+    with open(os.path.join(tmp, f"ok.rank{rank}"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -95,3 +95,13 @@ def test_lora_fuse_unfuse(hybrid):
         np.random.default_rng(5).integers(0, cfg.vocab_size, (eng.train_batch_size, 32)))
     l_with = float(eng.train_batch(batch).loss)
     assert np.isfinite(l_with)
+
+
+def test_lora_rejects_mismatched_adapter(hybrid):
+    eng, cfg = hybrid
+    with pytest.raises(ValueError, match="not in base params"):
+        eng.set_lora({"layers": {"attn": {"q_proj": {"a": np.zeros((2, 4, 2)),
+                                                     "b": np.zeros((2, 2, 4))}}}})
+    with pytest.raises(ValueError, match="does not match"):
+        eng.set_lora({"layers": {"attn": {"wq": {"a": np.zeros((cfg.num_layers, 8, 2)),
+                                                 "b": np.zeros((cfg.num_layers, 2, 8))}}}})
