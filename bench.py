@@ -1,18 +1,25 @@
 """Benchmark — prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
 Trains a Llama-style causal LM with the full engine on the available device(s)
-and reports model FLOPs utilization.  The measured config is the north-star
-shape (BASELINE.md): **ZeRO-3**, bf16 compute + fp32 master, Pallas flash
-attention, Pallas fused AdamW — at the largest model that fills this chip's
-HBM (~542M params, hidden 2048, seq 2048, on a single 16GB v5e).
+and reports model FLOPs utilization, plus (in ``extra``) the v2 ragged-serving
+decode throughput so the driver artifact carries both training and serving
+headline numbers.
+
+Measured config (sweep r3): **ZeRO-3**, bf16 compute + fp32 master, Pallas
+flash attention, Pallas fused AdamW — hidden 2304 x 9 layers GQA(18h/6kv),
+657M params, seq 2048, micro 6: the best MFU config that fits this chip's
+16GB HBM with master+moments resident (sweep: 542M/micro8 0.5449, 657M/micro6
+0.5533, 714M wide 0.5263, 770M/micro4 0.5002; 657M/micro8 OOMs by 0.8G).
 
 vs_baseline divides by the 0.40 MFU target BASELINE.md sets for the reference
-(ZeRO-3 Llama ≥40% MFU); extra.vs_ulysses_54pct compares against the Ulysses
-blog's sustained 54%-of-peak attention-layer figure
-(blogs/deepspeed-ulysses/README.md:82-83).
+(ZeRO-3 Llama >=40% MFU); extra.vs_ulysses_54pct compares against the Ulysses
+blog's sustained 54%-of-peak figure (blogs/deepspeed-ulysses/README.md:82-83).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -29,7 +36,6 @@ TARGET_MFU = 0.40  # BASELINE.md north-star
 
 
 def detect_peak():
-    import os
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     for key, val in PEAK_FLOPS.items():
         if key in gen:
@@ -43,46 +49,42 @@ def measure_collective_bw(n_bytes: int = 1 << 28, iters: int = 5):
     Multi-chip: times ``all_gather`` of an evenly sharded fp32 buffer over the
     data axis and reports busbw = (n-1)/n * bytes / t.  Single chip: no wire to
     measure, so report achievable HBM copy bandwidth instead (the bound an
-    on-chip gather would hit) under the key ``hbm_bw_gbps``.
+    on-chip gather would hit) under ``hbm_copy_gbps`` — timed with chained
+    ``jnp.roll`` (a real read+write of the whole buffer each iteration that
+    XLA cannot elide, unlike a scalar-multiply loop which fuses to ~nothing).
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
     n_dev = jax.device_count()
     elems = n_bytes // 4
-    # Multi-chip: the canonical implementation lives in comm/benchmark.py
-    # (the ds_bench analog); compiled_loop keeps relay dispatch out of dt.
-    from jax import lax
     if n_dev > 1:
         from deepspeed_tpu.comm.benchmark import collective_bandwidth
         res = collective_bandwidth("all_gather", elems=elems, dtype=jnp.float32,
                                    iters=iters, compiled_loop=True)
         return {"allgather_bw_gbps": round(res["busbw_gbps"], 2),
                 "allgather_bucket_mb": round(res["bytes"] / 1e6, 1)}
-    x = jnp.ones((elems,), jnp.float32)
-    loop = jax.jit(lambda v: lax.fori_loop(0, iters, lambda i, a: a * 1.0000001, v))
+    x = jnp.arange(elems, dtype=jnp.float32)
+    loop = jax.jit(lambda v: lax.fori_loop(0, iters, lambda i, a: jnp.roll(a, i + 1), v))
     float(loop(x)[0])  # compile + settle
     t0 = time.perf_counter()
     out = loop(x)
     float(out[0])
     dt = (time.perf_counter() - t0) / iters
-    return {"hbm_bw_gbps": round(2 * n_bytes / dt / 1e9, 2),  # read + write
+    return {"hbm_copy_gbps": round(2 * n_bytes / dt / 1e9, 2),  # read + write
             "allgather_bucket_mb": round(n_bytes / 1e6, 1)}
 
 
-def main():
+def measure_training(on_tpu: bool):
     import jax
 
     import deepspeed_tpu
     from deepspeed_tpu.models import llama
 
-    on_tpu = jax.devices()[0].platform != "cpu"
     if on_tpu:
-        # best measured config that fits 16GB HBM with fp32 master+moments
-        # resident (sweep r2): 2048x8/542M hit 0.540 MFU vs 0.536 for
-        # 1536x12/438M; 2048x10 and micro>8 OOM at compile, micro=6 regressed
-        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-                                num_layers=8, num_heads=16, num_kv_heads=16, max_seq_len=2048)
-        micro, seq, steps = 8, 2048, 30
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2304, intermediate_size=6144,
+                                num_layers=9, num_heads=18, num_kv_heads=6, max_seq_len=2048)
+        micro, seq, steps = 6, 2048, 30
     else:  # CPU smoke fallback
         cfg = llama.LlamaConfig.tiny()
         micro, seq, steps = 2, 64, 3
@@ -114,24 +116,119 @@ def main():
 
     tokens_per_sec = steps * engine.train_batch_size * seq / dt
     n_chips = jax.device_count()
-    flops_per_tok = llama.flops_per_token(cfg, seq)
-    mfu = tokens_per_sec * flops_per_tok / (detect_peak() * n_chips)
+    mfu = tokens_per_sec * llama.flops_per_token(cfg, seq) / (detect_peak() * n_chips)
+    return {
+        "mfu": mfu,
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+        "step_time_ms": round(dt / steps * 1e3, 1),
+        "model_params_m": round(llama.num_params(cfg) / 1e6, 1),
+        "seq_len": seq,
+        "chips": n_chips,
+    }
+
+
+def measure_decode(on_tpu: bool):
+    """v2 ragged-engine decode throughput (FastGen serving headline): 32 seqs
+    in steady-state greedy decode through the device-side burst path."""
+    import jax
+
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_seqs, prompt_len, burst_k, rounds = 32, 256, 32, 4
+        num_blocks, block_size, maxb = 2048, 32, 64
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+        n_seqs, prompt_len, burst_k, rounds = 4, 16, 4, 2
+        num_blocks, block_size, maxb = 64, 8, 16
+
+    eng = InferenceEngineV2(llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                            config={"dtype": "bfloat16" if on_tpu else "float32"},
+                            num_blocks=num_blocks, block_size=block_size,
+                            max_blocks_per_seq=maxb, token_budget=1024,
+                            max_seqs_per_step=n_seqs)
+    rng = np.random.default_rng(0)
+    eng.put(list(range(n_seqs)),
+            [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(n_seqs)])
+    while len(eng.step()) < n_seqs:  # prefill
+        pass
+    out = eng.decode_burst(burst_k)  # compile + warm
+    assert out is not None, "burst inapplicable at bench config"
+    t0 = time.perf_counter()
+    tokens = 0
+    for _ in range(rounds):
+        out = eng.decode_burst(burst_k)
+        assert out is not None, "burst fell back mid-bench (pool exhausted?)"
+        tokens += sum(len(v) for v in out.values())
+    dt = time.perf_counter() - t0
+    return {"decode_tok_s": round(tokens / dt, 1),
+            "decode_n_seqs": n_seqs,
+            "decode_model_params_m": round(llama.num_params(cfg) / 1e6, 1)}
+
+
+def measure_fsdp_virtual(timeout_s: int = 280):
+    """Overlap-shape check: one ZeRO-3 step over a data=2 x fsdp=4 VIRTUAL CPU
+    mesh in a subprocess (real fsdp>1 MFU needs a pod; this proves the sharded
+    step compiles+runs and reports its virtual step time)."""
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
+        "import sys; sys.path.insert(0, {repo!r});"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import time, numpy as np, deepspeed_tpu;"
+        "from deepspeed_tpu.models import llama;"
+        "from deepspeed_tpu.parallel import MeshTopology;"
+        "topo=MeshTopology.from_axis_dict({{'data':2,'fsdp':4}});"
+        "cfg=llama.LlamaConfig.tiny(vocab=256,hidden=128,layers=2,heads=4,kv_heads=2,seq=128);"
+        "e,_,_,_=deepspeed_tpu.initialize(loss_fn=llama.make_loss_fn(cfg),"
+        "model_parameters=llama.init_params(cfg,jax.random.PRNGKey(0)),topology=topo,"
+        "config={{'train_micro_batch_size_per_gpu':1,'optimizer':{{'type':'adamw','params':{{'lr':1e-3}}}},"
+        "'zero_optimization':{{'stage':3,'param_persistence_threshold':0}}}});"
+        "b=llama.causal_lm_batch(np.random.default_rng(0).integers(0,256,(e.train_batch_size,64)));"
+        "m=e.train_batch(b); float(m.loss);"
+        "t0=time.perf_counter(); m=e.train_batch(b); l=float(m.loss);"
+        "print('FSDP_OK', round((time.perf_counter()-t0)*1e3,1), l)"
+    ).format(repo=os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+        for line in r.stdout.splitlines():
+            if line.startswith("FSDP_OK"):
+                _, ms, loss = line.split()
+                if not np.isfinite(float(loss)):
+                    return {"fsdp_virtual8": f"nonfinite loss {loss}"}
+                return {"fsdp_virtual8_step_ms": float(ms), "fsdp_virtual8": "ok"}
+        return {"fsdp_virtual8": f"failed rc={r.returncode}: {(r.stderr or '')[-200:]}"}
+    except subprocess.TimeoutExpired:
+        return {"fsdp_virtual8": "timeout"}
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    train = measure_training(on_tpu)
+    decode = measure_decode(on_tpu)
     bw = measure_collective_bw(1 << 28 if on_tpu else 1 << 22,
                                iters=50 if on_tpu else 5)
+    fsdp = measure_fsdp_virtual() if on_tpu else {"fsdp_virtual8": "skipped_on_cpu"}
+    mfu = train.pop("mfu")
     print(json.dumps({
         "metric": "llama_zero3_bf16_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / TARGET_MFU, 4),
         "extra": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
-            "step_time_ms": round(dt / steps * 1e3, 1),
-            "model_params_m": round(llama.num_params(cfg) / 1e6, 1),
-            "seq_len": seq,
-            "chips": n_chips,
+            **train,
             "zero_stage": 3,
             "vs_ulysses_54pct": round(mfu / 0.54, 4),
+            **decode,
             **bw,
+            **fsdp,
         },
     }))
 

@@ -37,8 +37,10 @@ class DeepSpeedHybridEngine(Engine):
         self._inf_cfg.setdefault("dtype", "bfloat16" if self.compute_dtype == jnp.bfloat16 else "float32")
         self._inf_engine: Optional[InferenceEngine] = None
         self._params_version = -1
-        self._lora = lora_params
-        self._lora_fused = lora_params is not None
+        self._lora = None
+        self._lora_fused = False
+        if lora_params is not None:
+            self.set_lora(lora_params)  # validated, same as the post-init path
         log_dist("HybridEngine: training + rollout generation enabled", ranks=[0])
 
     # --------------------------------------------------------------- LoRA
@@ -53,8 +55,12 @@ class DeepSpeedHybridEngine(Engine):
         train step keeps seeing the unfused base params.
         """
         if lora_params is not None:
-            self._validate_lora(self.state.params if self.state is not None
-                                else self._compute_params, lora_params)
+            base = (self.state.params if self.state is not None
+                    else getattr(self, "_compute_params", None))
+            if base is None:
+                raise ValueError("hybrid engine generation/LoRA is not available on the "
+                                 "offload_param:nvme streaming path (no resident params)")
+            self._validate_lora(base, lora_params)
         self._lora = lora_params
         self._lora_fused = lora_params is not None
         self._params_version = -1  # force a weight refresh on next generate
@@ -71,7 +77,14 @@ class DeepSpeedHybridEngine(Engine):
                 raise ValueError(f"LoRA adapter at {path or '<root>'} targets a non-leaf")
             a, b = jnp.shape(lora["a"]), jnp.shape(lora["b"])
             w = jnp.shape(params)
-            if a[:-2] + (a[-2], b[-1]) != w or a[-1] != b[-2]:
+            ok = len(a) >= 2 and len(b) >= 2 and len(w) >= 2 \
+                and a[-1] == b[-2] and a[-2] == w[-2] and b[-1] == w[-1]
+            if ok:
+                try:  # batch dims may broadcast (shared adapter over stacked layers)
+                    ok = np.broadcast_shapes(a[:-2], b[:-2], w[:-2]) == w[:-2]
+                except ValueError:
+                    ok = False
+            if not ok:
                 raise ValueError(f"LoRA shapes at {path}: a{a} @ b{b} does not match W{w}")
             return
         if not isinstance(lora, dict) or not isinstance(params, dict):

@@ -105,3 +105,17 @@ def test_lora_rejects_mismatched_adapter(hybrid):
     with pytest.raises(ValueError, match="does not match"):
         eng.set_lora({"layers": {"attn": {"wq": {"a": np.zeros((cfg.num_layers, 8, 2)),
                                                  "b": np.zeros((cfg.num_layers, 2, 8))}}}})
+
+
+def test_lora_shared_adapter_broadcasts(hybrid):
+    """An unstacked adapter (no leading L dim) broadcasts over stacked layers."""
+    eng, cfg = hybrid
+    D, r = cfg.hidden_size, 2
+    eng.set_lora({"layers": {"attn": {"wq": {
+        "a": np.asarray(jax.random.normal(jax.random.PRNGKey(1), (D, r))) * 0.1,
+        "b": np.asarray(jax.random.normal(jax.random.PRNGKey(2), (r, D))) * 0.1}}}})
+    ids = np.random.default_rng(7).integers(1, cfg.vocab_size, (1, 4))
+    base = np.asarray(eng.eval_forward(ids))
+    eng.unfuse_lora_weight()
+    unfused = np.asarray(eng.eval_forward(ids))
+    assert not np.allclose(base, unfused)
