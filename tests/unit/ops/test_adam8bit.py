@@ -1,0 +1,140 @@
+"""8-bit blockwise Adam (ops/adam/adam8bit.py + runtime fused_adam8bit).
+
+Reference pattern: tests/unit/ops/adam/test_adamw.py (kernel vs trusted math);
+quantized-state fidelity checks follow the quantizer tests' roundtrip style.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import _pallas
+from deepspeed_tpu.ops.adam import adam8bit
+from deepspeed_tpu.runtime.optimizers import get_optimizer
+
+
+def _fp32_adamw(p, m, v, g, lr, b1=0.9, b2=0.999, eps=1e-8, wd=0.0, step=1):
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    bc1, bc2 = 1 - b1**step, 1 - b2**step
+    return p - lr * ((m / bc1) / (jnp.sqrt(v / bc2) + eps) + wd * p), m, v
+
+
+def test_one_step_close_to_fp32():
+    """A single step from zero moments matches exact fp32 AdamW to int8
+    quantization error (the step-1 moments are exactly representable up to the
+    per-group scale)."""
+    n = 3000
+    p = jax.random.normal(jax.random.PRNGKey(0), (n, ), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(1), (n, ), jnp.float32)
+    m8, sm = adam8bit.init_quantized_moment(n, 1024)
+    v8, sv = adam8bit.init_quantized_moment(n, 1024)
+    p_k, *_ = adam8bit.fused_adamw8bit_flat(p, m8, v8, sm, sv, g, lr=1e-2,
+                                            weight_decay=0.01, step=1,
+                                            use_kernel=False)
+    p_ref, _, _ = _fp32_adamw(p, jnp.zeros(n), jnp.zeros(n), g, 1e-2, wd=0.01)
+    np.testing.assert_allclose(np.asarray(p_k), np.asarray(p_ref), atol=1e-5)
+
+
+def test_kernel_matches_xla_path():
+    n = 2048 + 17  # exercise tail padding
+    p = jax.random.normal(jax.random.PRNGKey(0), (n, ), jnp.float32)
+    m8, sm = adam8bit.init_quantized_moment(n, 1024)
+    v8, sv = adam8bit.init_quantized_moment(n, 1024)
+    outs = {}
+    for name, interp in (("xla", False), ("kernel", True)):
+        _pallas.INTERPRET = interp
+        try:
+            kw = dict(lr=1e-2, weight_decay=0.01, group_size=1024)
+            st = (p, m8, sm, v8, sv)
+            pp, mm, ss_m, vv, ss_v = p, m8, sm, v8, sv
+            for step in (1, 2, 3):
+                g = jax.random.normal(jax.random.PRNGKey(step), (n, ), jnp.float32)
+                pp, mm, vv, ss_m, ss_v = adam8bit.fused_adamw8bit_flat(
+                    pp, mm, vv, ss_m, ss_v, g, step=step,
+                    use_kernel=(name == "kernel"), **kw)
+            outs[name] = np.asarray(pp)
+        finally:
+            _pallas.INTERPRET = False
+    # int8 requant rounding is the only divergence source
+    np.testing.assert_allclose(outs["kernel"], outs["xla"], atol=2e-5, rtol=1e-5)
+
+
+def test_multi_step_tracks_fp32():
+    """50 steps on a quadratic: quantized trajectory stays near fp32 AdamW and
+    reaches the same loss basin (the convergence claim behind the 1.4B-fits
+    bench leg)."""
+    key = jax.random.PRNGKey(0)
+    W = jax.random.normal(key, (16, 8))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 16))
+    y = x @ W
+    loss_fn = lambda w: jnp.mean((x @ w - y) ** 2)
+
+    def train(opt_name):
+        opt = get_optimizer(opt_name)
+        w = jnp.zeros((16, 8))
+        st = opt.init({"w": w})
+
+        @jax.jit
+        def step(w, st):
+            l, g = jax.value_and_grad(loss_fn)(w)
+            upd, st = opt.update({"w": g}, st, {"w": w}, 5e-2)
+            return w + upd["w"], st, l
+
+        for _ in range(80):
+            w, st, l = step(w, st)
+        return float(l)
+
+    l8, l32 = train("fused_adam8bit"), train("adamw")
+    assert np.isfinite(l8)
+    assert l8 < 0.1 and l32 < 0.1  # both reach the basin
+    assert l8 < 10 * max(l32, 1e-4)
+
+
+def test_state_memory_is_quantized():
+    opt = get_optimizer("fused_adam8bit")
+    params = {"a": jnp.zeros((300, 70)), "b": jnp.zeros((5, ))}
+    st = opt.init(params)
+    assert st.exp_avg["a"].dtype == jnp.int8
+    assert st.exp_avg_sq["a"].dtype == jnp.int8
+    assert st.exp_avg["a"].shape == (21, 1024)  # ceil(21000/1024) groups
+    assert st.scale_m["a"].shape == (21, 1)
+    # state bytes ~ 2.01/param vs 8 for fp32 moments
+    n = 300 * 70
+    state_bytes = (st.exp_avg["a"].size + st.exp_avg_sq["a"].size
+                   + 4 * st.scale_m["a"].size + 4 * st.scale_v["a"].size)
+    assert state_bytes < 0.27 * (8 * n)
+
+
+def test_dequantize_moments_roundtrip():
+    n = 2048
+    g = jax.random.normal(jax.random.PRNGKey(0), (n, ), jnp.float32)
+    p = jnp.zeros(n)
+    m8, sm = adam8bit.init_quantized_moment(n, 1024)
+    v8, sv = adam8bit.init_quantized_moment(n, 1024)
+    _, m8, v8, sm, sv = adam8bit.fused_adamw8bit_flat(
+        p, m8, v8, sm, sv, g, lr=1e-3, step=1, use_kernel=False)
+    m, v = adam8bit.dequantize_moments(m8, v8, sm, sv, n)
+    # tolerance = half a quantization bucket: m scale ~ 0.1*max|g|/127,
+    # v in sqrt domain so abs error ~ 2*u*(umax/254)
+    np.testing.assert_allclose(np.asarray(m), 0.1 * np.asarray(g), rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(v), 1e-3 * np.asarray(g) ** 2, rtol=6e-2, atol=2e-4)
+
+
+def test_engine_integration():
+    """Engine train loop with fused_adam8bit (ZeRO-3 config) drives loss down."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn,
+        model_parameters=init_mlp_params(jax.random.PRNGKey(0), hidden=32),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "fused_adam8bit", "params": {"lr": 3e-2}},
+                "zero_optimization": {"stage": 3}})
+    losses = [float(engine.train_batch(
+                  random_batch(engine.train_batch_size, hidden=32, seed=i)).loss)
+              for i in range(30)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.5 * losses[0]
