@@ -1,0 +1,20 @@
+# Test lanes (VERDICT r3 #9: kernel-parity regressions must not hide behind
+# the default `-m "not slow"` lane).  `make fast_then_slow` is the CI target;
+# it also writes TESTS_LANES.json with both lanes' counts, which bench.py
+# folds into the bench artifact's extra section.
+
+PY ?= python
+
+.PHONY: test test-slow fast_then_slow bench
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
+
+fast_then_slow:
+	$(PY) run_tests.py
+
+bench:
+	$(PY) bench.py

@@ -61,16 +61,62 @@ def initialize(args=None,
 
     if tp_rules is None and model is not None:
         tp_rules = getattr(model, "tp_rules", None)
+
+    from .models import transformer as _transformer
+    if cfg.sparse_attention is not None:
+        # The reference swaps attention modules for SparseSelfAttention when the
+        # JSON's sparse_attention section is set (sparse_self_attention.py:99);
+        # functionally, install the blocksparse kernel as the process-wide
+        # default attention_fn — models built on models.transformer.attention_block
+        # pick it up at trace time (opaque loss_fns that don't are unaffected).
+        from .ops.sparse_attention.attention import make_config_attention_fn
+        from .utils.logging import log_dist
+        _transformer.set_default_attention(make_config_attention_fn(cfg.sparse_attention))
+        log_dist(f"sparse_attention: installed blocksparse kernel "
+                 f"(mode={cfg.sparse_attention.mode}, block={cfg.sparse_attention.block}) "
+                 f"as the default attention_fn for models routed through "
+                 f"models.transformer.attention_block", ranks=[0])
+    else:
+        # a previous initialize() in this process may have installed one; this
+        # engine's config didn't ask for it — clear, don't leak
+        _transformer.set_default_attention(None)
+
     engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules,
                     param_init_fn=param_init_fn,
-                    layer_fn=kwargs.pop("layer_fn", None), head_fn=kwargs.pop("head_fn", None))
+                    layer_fn=kwargs.pop("layer_fn", None), head_fn=kwargs.pop("head_fn", None),
+                    stem_fn=kwargs.pop("stem_fn", None))
+
+    if cfg.data_efficiency.enabled and cfg.data_efficiency.data_routing.enabled:
+        from .utils.logging import logger
+        logger.warning(
+            "data_efficiency.data_routing (random-LTD) is enabled in config, but the "
+            "engine cannot rewrite an opaque loss_fn — apply "
+            "runtime.data_pipeline.random_ltd in the model's layer stack "
+            "(reference convert_to_random_ltd rewrites modules; the functional "
+            "analog is a model-side opt-in)")
 
     dataloader = None
     if training_data is not None:
-        dataloader = DeepSpeedDataLoader(training_data,
-                                         batch_size=engine.train_batch_size,
-                                         seed=cfg.seed,
-                                         collate_fn=collate_fn)
+        curriculum = cfg.effective_curriculum()
+        if curriculum is not None:
+            from .runtime.dataloader import CurriculumDataLoader
+            from .utils.logging import log_dist
+            dataloader = CurriculumDataLoader(
+                training_data,
+                batch_size=engine.train_batch_size,
+                gradient_accumulation_steps=engine.gradient_accumulation_steps,
+                curriculum=curriculum,
+                seed=cfg.data_efficiency.seed if cfg.data_efficiency.enabled else cfg.seed,
+                collate_fn=collate_fn)
+            log_dist(f"data_efficiency: curriculum data sampler active "
+                     f"(schedule={curriculum.get('schedule_type', curriculum.get('curriculum_type'))}, "
+                     f"min={curriculum.get('min_difficulty')}, max={curriculum.get('max_difficulty')})",
+                     ranks=[0])
+        else:
+            dataloader = DeepSpeedDataLoader(training_data,
+                                             batch_size=engine.train_batch_size,
+                                             seed=cfg.seed,
+                                             collate_fn=collate_fn)
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 

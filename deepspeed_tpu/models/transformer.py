@@ -102,6 +102,34 @@ def default_attention():
     return sdpa
 
 
+# Config-installed attention override — the functional analog of the
+# reference's module injection swapping attention for SparseSelfAttention when
+# the JSON's ``sparse_attention`` section is set (sparse_self_attention.py:99,
+# wired by initialize()).  Models that route through attention_block pick it up
+# at trace time unless they pass an explicit attention_fn; "engaged" records
+# that a trace actually consumed it (tested, not just installed).
+_CONFIGURED_ATTENTION = {"fn": None, "engaged": False}
+
+
+def set_default_attention(fn):
+    """Install (or clear, fn=None) the process-wide default attention_fn."""
+    _CONFIGURED_ATTENTION["fn"] = fn
+    _CONFIGURED_ATTENTION["engaged"] = False
+
+
+def configured_attention_engaged() -> bool:
+    return _CONFIGURED_ATTENTION["engaged"]
+
+
+def _resolve_attention(attention_fn):
+    if attention_fn is not None:
+        return attention_fn
+    if _CONFIGURED_ATTENTION["fn"] is not None:
+        _CONFIGURED_ATTENTION["engaged"] = True
+        return _CONFIGURED_ATTENTION["fn"]
+    return default_attention()
+
+
 def attention_block(params, x, *, n_heads, n_kv_heads, cos, sin, causal=True,
                     attention_fn=None, positions=None, kv_cache=None):
     """Multi-head attention with rotary + GQA.
@@ -127,14 +155,14 @@ def attention_block(params, x, *, n_heads, n_kv_heads, cos, sin, causal=True,
         # mask out cache positions beyond cache_len + s
         kpos = jnp.arange(k_cache.shape[1])[None, None, None, :]
         valid = kpos < (cache_len + s)
-        attn_fn = attention_fn or default_attention()
+        attn_fn = _resolve_attention(attention_fn)
         qpos = (jnp.arange(s) + cache_len)
         # causal over absolute positions
         causal_mask = kpos[:, :, :, :] <= qpos[None, None, :, None]
         out = attn_fn(q, k_full, v_full, causal=False, mask=jnp.logical_and(valid, causal_mask))
         new_cache = (k_cache, v_cache, cache_len + s)
     else:
-        attn_fn = attention_fn or default_attention()
+        attn_fn = _resolve_attention(attention_fn)
         out = attn_fn(q, k, v, causal=causal)
     out = out.reshape(b, s, n_heads * head_dim)
     out = out @ params["wo"].astype(x.dtype)

@@ -441,6 +441,34 @@ def make_sparse_attention_fn(config, max_seq_length: int):
     return attention_fn
 
 
+def make_config_attention_fn(section):
+    """Build an ``attention_fn`` straight from the runtime config's
+    ``sparse_attention`` section (runtime/config.py SparseAttentionConfig) —
+    the path the reference covers by constructing SparseSelfAttention from
+    ``get_sparse_attention(config)`` (sparse_self_attention.py:99).
+
+    The SparsityConfig needs ``num_heads`` and the layout needs the sequence
+    length, both known only at trace time from q's shape — so the layout is
+    built lazily and cached per (heads, seq).  Decode-shaped calls
+    (s_q != s_k) and sequences not divisible by ``block`` fall back to the
+    dense default (the reference pads via sparse_attention_utils; here models
+    own their padding, see pad_to_block_size)."""
+    cache = {}
+
+    def attention_fn(q, k, v, causal=True, mask=None, softmax_scale=None):
+        s, h = q.shape[1], q.shape[2]
+        if q.shape[1] != k.shape[1] or s % section.block != 0:
+            from ...models.transformer import default_attention
+            return default_attention()(q, k, v, causal=causal, mask=mask,
+                                       softmax_scale=softmax_scale)
+        if (h, s) not in cache:
+            cache[(h, s)] = section.build(h).make_layout(s)
+        return sparse_attention(q, k, v, cache[(h, s)], section.block, causal=causal,
+                                softmax_scale=softmax_scale, mask=mask)
+
+    return attention_fn
+
+
 def pad_to_block_size(block: int, x, pad_token_id: int = 0):
     """Right-pad token ids [B, S] to a multiple of ``block`` (the analog of
     sparse_attention_utils.pad_to_block_size, which the reference applies to

@@ -100,9 +100,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._thread.join()
 
 
-def build_checkpoint_engine(kind: str = "native") -> CheckpointEngine:
+def build_checkpoint_engine(kind: str = "native", max_queue: int = 64) -> CheckpointEngine:
     if kind in ("native", "torch"):
         return NativeCheckpointEngine()
     if kind in ("async", "nebula"):
-        return AsyncCheckpointEngine()
+        return AsyncCheckpointEngine(max_queue=max_queue)
     raise ValueError(f"unknown checkpoint engine '{kind}' (native|async)")

@@ -285,9 +285,77 @@ class GradientCompressionConfig(ConfigModel):
     freeze_step: int = Field(100, ge=0)
 
 
-class DataEfficiencyConfig(ConfigModel):
+class CheckpointSectionConfig(ConfigModel):
+    """Reference: the "checkpoint" section (runtime/config.py
+    ``get_checkpoint_params``) plus engine selection — the reference picks the
+    Nebula async engine vs torch from config in ``_configure_checkpointing``
+    (runtime/engine.py:921).  ``checkpoint_engine`` here selects the plug-in
+    built by runtime/checkpoint_engine.build_checkpoint_engine."""
+    allow_extra = True
+    checkpoint_engine: str = Field("native", choices=("native", "torch", "async", "nebula"))
+    async_max_queue: int = Field(64, ge=1)
+    tag_validation: Optional[str] = Field(None, choices=(None, "Ignore", "Warn", "Fail",
+                                                         "ignore", "warn", "fail"))
+    use_node_local_storage: bool = False
+    parallel_write: Optional[Dict[str, Any]] = None
+
+
+class NebulaConfig(ConfigModel):
+    """Reference: top-level "nebula" section (nebula/config.py) — enabling it
+    selects the async (background-writer) checkpoint engine."""
     allow_extra = True
     enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = Field(100, ge=1)
+    num_of_version_in_retention: int = Field(2, ge=1)
+    enable_nebula_load: bool = True
+
+
+class DataSamplingConfig(ConfigModel):
+    """Reference: data_efficiency.data_sampling (runtime/data_pipeline/config.py:37)
+    — the curriculum_learning sub-dict feeds CurriculumScheduler; the reference's
+    multi-metric ``curriculum_metrics`` form is accepted, with the ``seqlen``
+    metric driving batch truncation (the reference's default difficulty proxy)."""
+    allow_extra = True
+    enabled: bool = True
+    num_workers: int = 0
+    curriculum_learning: Dict[str, Any] = Field(dict)
+
+
+class DataRoutingConfig(ConfigModel):
+    """Reference: data_efficiency.data_routing (random-LTD; runtime/data_pipeline/
+    config.py:77).  The library lives in runtime/data_pipeline/random_ltd.py;
+    models opt in by wrapping their layer stack (initialize() warns loudly when
+    the section is enabled, since an opaque loss_fn can't be rewritten)."""
+    allow_extra = True
+    enabled: bool = False
+    random_ltd: Dict[str, Any] = Field(dict)
+
+
+class DataEfficiencyConfig(ConfigModel):
+    """Reference: DeepSpeedDataEfficiencyConfig (runtime/data_pipeline/config.py:12),
+    activated through the engine's dataloader (engine.deepspeed_io:1686)."""
+    allow_extra = True
+    enabled: bool = False
+    seed: int = Field(1234, ge=0)
+    data_sampling: DataSamplingConfig = Field(DataSamplingConfig)
+    data_routing: DataRoutingConfig = Field(DataRoutingConfig)
+
+    def curriculum_dict(self) -> Optional[Dict[str, Any]]:
+        """The CurriculumScheduler config when curriculum sampling is active,
+        else None.  Accepts both the flat schedule form and the reference's
+        ``curriculum_metrics: {seqlen: {...}}`` nesting."""
+        cl = dict(self.data_sampling.curriculum_learning or {})
+        if not (self.enabled and self.data_sampling.enabled and cl.pop("enabled", False)):
+            return None
+        metrics = cl.pop("curriculum_metrics", None)
+        if metrics:
+            name = "seqlen" if "seqlen" in metrics else next(iter(metrics))
+            if len(metrics) > 1:
+                logger.warning(f"data_efficiency curriculum_metrics: multiple metrics "
+                               f"configured; using {name!r} for difficulty (seqlen truncation)")
+            return dict(metrics[name])
+        return cl or None
 
 
 class TrainingConfig(ConfigModel):
@@ -327,6 +395,11 @@ class TrainingConfig(ConfigModel):
     gradient_compression: GradientCompressionConfig = Field(GradientCompressionConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
     data_efficiency: DataEfficiencyConfig = Field(DataEfficiencyConfig)
+    # legacy pre-data_efficiency curriculum section (reference runtime/config.py
+    # ``get_curriculum_params`` — curriculum_type/min/max/schedule keys)
+    curriculum_learning: Optional[Dict[str, Any]] = None
+    checkpoint: CheckpointSectionConfig = Field(CheckpointSectionConfig)
+    nebula: NebulaConfig = Field(NebulaConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
@@ -343,6 +416,26 @@ class TrainingConfig(ConfigModel):
         if self.bf16 is None:
             # TPU-first default: bf16 on unless fp16 explicitly requested.
             object.__setattr__(self, "bf16", BF16Config(enabled=not self.fp16.enabled))
+        if self.checkpoint.tag_validation is not None:
+            object.__setattr__(self, "checkpoint_tag_validation", self.checkpoint.tag_validation)
+
+    def checkpoint_engine_kind(self) -> str:
+        """Engine plug-in selection (reference _configure_checkpointing,
+        engine.py:921): the "nebula" section wins, else checkpoint.checkpoint_engine."""
+        if self.nebula.enabled:
+            return "async"
+        return self.checkpoint.checkpoint_engine
+
+    def effective_curriculum(self) -> Optional[Dict[str, Any]]:
+        """Curriculum schedule dict from either the data_efficiency section or
+        the legacy top-level curriculum_learning section; None when inactive."""
+        cur = self.data_efficiency.curriculum_dict()
+        if cur is not None:
+            return cur
+        legacy = dict(self.curriculum_learning or {})
+        if legacy.pop("enabled", False):
+            return legacy
+        return None
 
     # --- batch-size triple reconciliation (reference runtime/config.py:837) ---
     def resolve_batch_sizes(self, dp_world_size: int):

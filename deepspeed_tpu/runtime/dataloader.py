@@ -90,6 +90,74 @@ class DeepSpeedDataLoader:
         self.seed = sd["seed"]
 
 
+class CurriculumDataLoader:
+    """Config-driven curriculum loader — what the reference's engine builds in
+    ``deepspeed_io`` when data_efficiency curriculum sampling is on
+    (runtime/engine.py:1686 + data_sampling/data_sampler.py:36): batch indices
+    come from DeepSpeedDataSampler and every batch's sequence dim is truncated
+    to the scheduler's current difficulty (seqlen).
+
+    Single-controller JAX assembles the GLOBAL macro-batch, so the sampler runs
+    with dp_size=1 and micro_batch = train_batch / gas; the engine shards the
+    batch over the dp mesh axes at device_put time."""
+
+    def __init__(self, dataset, batch_size: int, gradient_accumulation_steps: int,
+                 curriculum: dict, seed: int = 0, drop_last: bool = True,
+                 collate_fn: Optional[Callable] = None, seq_axis: int = 1):
+        from .data_pipeline.data_sampler import DeepSpeedDataSampler
+        if batch_size % gradient_accumulation_steps:
+            raise ValueError(f"batch_size={batch_size} not divisible by "
+                             f"gas={gradient_accumulation_steps}")
+        self.dataset = dataset
+        self.collate_fn = collate_fn or _default_collate
+        self.seq_axis = seq_axis
+        self.batch_size = batch_size
+        self.data_sampler = DeepSpeedDataSampler(
+            total_samples=len(dataset),
+            micro_batch_size=batch_size // gradient_accumulation_steps,
+            data_parallel_rank=0, data_parallel_size=1,
+            gradient_accumulation_steps=gradient_accumulation_steps,
+            curriculum=curriculum, seed=seed, drop_last=drop_last)
+        self.current_seqlen: Optional[int] = None
+
+    def __len__(self):
+        return len(self.dataset) // self.batch_size
+
+    def _truncate(self, batch, seqlen: int):
+        ax = self.seq_axis
+
+        def trim(x):
+            x = np.asarray(x)
+            if x.ndim > ax and x.shape[ax] > seqlen:
+                return np.take(x, np.arange(seqlen), axis=ax)
+            return x
+
+        import jax
+        return jax.tree_util.tree_map(trim, batch)
+
+    def __iter__(self) -> Iterator:
+        # one EPOCH per __iter__ (the contract of the DeepSpeedDataLoader this
+        # replaces — `for epoch in ...: for batch in loader:` must terminate);
+        # the underlying sampler is an infinite stream, so each pass yields
+        # len(self) batches and resumes where the previous epoch stopped
+        it = iter(self.data_sampler)
+        for _ in range(len(self)):
+            # difficulty BEFORE consuming the batch, like the reference's
+            # sampler (curriculum difficulty for step N applies to batch N)
+            self.current_seqlen = self.data_sampler.get_seqlen()
+            idx = next(it)
+            batch = self.collate_fn([self.dataset[int(i)] for i in idx])
+            if self.current_seqlen is not None:
+                batch = self._truncate(batch, self.current_seqlen)
+            yield batch
+
+    def state_dict(self):
+        return self.data_sampler.state_dict()
+
+    def load_state_dict(self, sd):
+        self.data_sampler.load_state_dict(sd)
+
+
 def _default_collate(samples):
     import jax
     return jax.tree_util.tree_map(lambda *xs: np.stack([np.asarray(x) for x in xs]), *samples)

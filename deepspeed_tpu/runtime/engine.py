@@ -88,8 +88,10 @@ class Engine:
                  tp_rules=None,
                  param_init_fn: Optional[Callable] = None,
                  layer_fn: Optional[Callable] = None,
-                 head_fn: Optional[Callable] = None):
+                 head_fn: Optional[Callable] = None,
+                 stem_fn: Optional[Callable] = None):
         self.config = config
+        self._stem_fn = stem_fn
         self.loss_fn = loss_fn
         self.topology = topology or MeshTopology.build(_mesh_config_for(config))
         set_topology(self.topology)
@@ -138,6 +140,7 @@ class Engine:
         self._micro_batches: list = []
         self._compiled_step = None
         self._compiled_eval = None
+        self._ckpt_engine = None  # built lazily from config (checkpoint/nebula)
 
         act_cfg = config.activation_checkpointing
         if act_cfg.cpu_checkpointing or act_cfg.policy != "nothing_saveable":
@@ -292,17 +295,31 @@ class Engine:
         swapper = AsyncPartitionedParameterSwapper(path, buffer_count=off_p.buffer_count)
         stacked = params["layers"]
         num_layers = int(np.shape(jax.tree_util.tree_leaves(stacked)[0])[0])
+        # offload_optimizer: cpu + offload_param: nvme => moments pinned in host
+        # RAM (one tier up), halving per-step disk traffic — the reference's
+        # mixed ZeRO-Infinity placement (offload_config.py device per tier)
+        off_o = self.config.zero_optimization.offload_optimizer
+        opt_device = "cpu" if (off_o is not None and off_o.device == "cpu") else "nvme"
+        stem_fn = getattr(self, "_stem_fn", None)
         trainer = SwappedLayerTrainer(layer_fn, num_layers, head_fn, swapper,
                                       lr=self.base_lr,
                                       betas=tuple(opt_params.get("betas", (0.9, 0.999))),
                                       eps=float(opt_params.get("eps", 1e-8)),
                                       weight_decay=float(opt_params.get("weight_decay", 0.0)),
-                                      compute_dtype=self.compute_dtype)
-        trainer.init_from_stacked(stacked, {k: v for k, v in params.items() if k != "layers"})
+                                      compute_dtype=self.compute_dtype,
+                                      stem_fn=stem_fn,
+                                      optimizer_device=opt_device)
+        # "stem" is reserved ONLY when a stem_fn claims it; without one it
+        # stays in the head params (e.g. head_fn reading params["stem"])
+        head_keys = ("layers", "stem") if stem_fn is not None else ("layers", )
+        trainer.init_from_stacked(
+            stacked,
+            {k: v for k, v in params.items() if k not in head_keys},
+            stem_params=params.get("stem") if stem_fn is not None else None)
         self._nvme_trainer = trainer
         self.state = None
         log_dist(f"Engine: ZeRO-Infinity NVMe param streaming — {num_layers} layers, "
-                 f"buffer_count={off_p.buffer_count}, path={path}", ranks=[0])
+                 f"buffer_count={off_p.buffer_count}, moments={opt_device}, path={path}", ranks=[0])
 
     def _init_offload(self, params, off_cfg):
         """ZeRO-Offload/Infinity analog (reference swap_tensor + cpu_adam): fp32
@@ -770,6 +787,22 @@ class Engine:
                 raise ValueError(msg)
             logger.warning(msg)
 
+    @property
+    def checkpoint_engine(self):
+        """Config-selected persistence plug-in (reference _configure_checkpointing,
+        engine.py:921: Nebula async vs torch).  Built lazily so engines that
+        never checkpoint don't spawn the async writer thread."""
+        if self._ckpt_engine is None:
+            from .checkpoint_engine.checkpoint_engine import build_checkpoint_engine
+            kind = self.config.checkpoint_engine_kind()
+            self._ckpt_engine = build_checkpoint_engine(
+                kind, max_queue=self.config.checkpoint.async_max_queue)
+            if kind not in ("native", "torch"):
+                log_dist(f"checkpoint engine: {kind} "
+                         f"({type(self._ckpt_engine).__name__} — background writer; "
+                         f"commit() at tag boundaries makes saves durable)", ranks=[0])
+        return self._ckpt_engine
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[dict] = None):
         self._nvme_guard("save_checkpoint")
         tag = tag or f"global_step{self.global_steps}"
@@ -781,7 +814,8 @@ class Engine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         state = self.state if self.offload_device is None else self._offload_host_state()
-        save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config)
+        save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config,
+                            engine=self.checkpoint_engine)
         return tag
 
     def _offload_host_state(self):
@@ -800,6 +834,8 @@ class Engine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
         self._nvme_guard("load_checkpoint")
+        if self.config.load_universal_checkpoint:
+            return self._load_universal_checkpoint(load_dir, tag, load_optimizer_states)
         if self.offload_device is not None:
             return self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
         state, client_state = load_checkpoint_dir(load_dir,
@@ -841,6 +877,124 @@ class Engine:
         self.global_samples = client_state.get("global_samples", 0)
         if "lr_scheduler" in client_state:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return tag, client_state
+
+    def _load_universal_checkpoint(self, load_dir, tag, load_optimizer_states=True):
+        """Resume from the universal atom format at ANY topology/optimizer —
+        the reference's ``engine.load_universal_checkpoint`` (engine.py:813) +
+        ``load_hp_checkpoint_state`` (checkpoint/universal_checkpoint.py:12),
+        engaged by ``load_universal_checkpoint: true`` in config.
+
+        ``load_dir`` may point directly at a ds_to_universal output (contains
+        universal_metadata.json) or at a checkpoint root whose ``<tag>/``
+        subdirectory holds one.  Param leaves rebuild from their fp32 atoms;
+        optimizer leaves match atoms by the same suffix discovery used at
+        conversion, so any optimizer whose state mirrors the param tree (adam,
+        lion, lamb, sgd momentum) resumes — including into a DIFFERENT
+        optimizer, where unmatched moments warn and keep their init values.
+        Atoms saved with vocab padding stripped are zero-re-padded on dim 0
+        (reference merge_tp_slices vocab fixups, ds_to_universal.py:156)."""
+        from ..checkpoint.universal import PARAM_ATOM, load_universal
+        from .checkpointing import _leaf_key, get_latest_tag
+        udir = load_dir
+        if not os.path.exists(os.path.join(udir, "universal_metadata.json")):
+            tag = tag or get_latest_tag(load_dir)
+            if tag is not None and os.path.exists(os.path.join(load_dir, tag, "universal_metadata.json")):
+                udir = os.path.join(load_dir, tag)
+            else:
+                raise FileNotFoundError(
+                    f"load_universal_checkpoint: no universal_metadata.json under {load_dir}"
+                    + (f" or {load_dir}/{tag}" if tag else "") +
+                    " — convert a checkpoint first (python -m deepspeed_tpu.checkpoint.universal)")
+        data = load_universal(udir)
+        atoms, passthrough = data["params"], data["passthrough"]
+        by_len = sorted(atoms, key=len, reverse=True)
+
+        def lookup(key: str):
+            if key.startswith("params."):
+                p = key[len("params."):]
+                return atoms[p][PARAM_ATOM] if p in atoms else None
+            if key.startswith("opt_state."):
+                if not load_optimizer_states:
+                    return None
+                rest = key[len("opt_state."):]
+                for p in by_len:
+                    if rest.endswith("." + p):
+                        got = atoms[p].get(rest[:-(len(p) + 1)])
+                        if got is not None:
+                            return got
+                return passthrough.get(key)
+            return passthrough.get(key)
+
+        def fit(arr, cur, key):
+            want = tuple(np.shape(cur))
+            if tuple(arr.shape) != want:
+                if (arr.ndim == len(want) and arr.ndim >= 1 and arr.shape[0] < want[0]
+                        and tuple(arr.shape[1:]) == tuple(want[1:])):
+                    pad = np.zeros((want[0] - arr.shape[0], ) + tuple(arr.shape[1:]), arr.dtype)
+                    arr = np.concatenate([arr, pad], axis=0)
+                    log_dist(f"universal load: re-padded {key} dim0 "
+                             f"{arr.shape[0] - pad.shape[0]} -> {want[0]} (vocab padding)", ranks=[0])
+                else:
+                    raise ValueError(f"universal atom {key} shape {arr.shape} != model {want}")
+            dtype = getattr(cur, "dtype", None)
+            return arr.astype(dtype) if dtype is not None and arr.dtype != dtype else arr
+
+        if self.offload_device is not None:
+            # host-offloaded Adam: atoms land in the host buffers via the same
+            # state_dict path the native offload resume uses
+            template = lambda shape: np.empty(shape, np.float32)
+            sd = {"m": {}, "v": {}, "step": int(passthrough.get("opt_state.step", 0))}
+            for key, shape in zip(self._offload_keys, self._offload_shapes):
+                a = atoms.get(key)
+                if a is None:
+                    logger.warning(f"universal load: no atom for param {key}; keeping current")
+                    continue
+                self._offload_state.params[key][...] = fit(a[PARAM_ATOM], template(shape), key).ravel()
+                if load_optimizer_states:
+                    if "exp_avg" in a:
+                        sd["m"][key] = fit(a["exp_avg"], template(shape), key).ravel()
+                    if "exp_avg_sq" in a:
+                        sd["v"][key] = fit(a["exp_avg_sq"], template(shape), key).ravel()
+                    extra = sorted(set(a) - {PARAM_ATOM, "exp_avg", "exp_avg_sq"})
+                    if extra or "exp_avg" not in a:
+                        logger.warning(
+                            f"universal load (offload): param {key} has atoms {sorted(a)} "
+                            f"but the host-offload Adam consumes exp_avg/exp_avg_sq only — "
+                            f"unmatched moments keep their current (zero) values")
+            if load_optimizer_states and sd["m"]:
+                self._offload_state.load_state_dict(sd)
+            self._push_compute_params()
+        else:
+            shardings = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
+            leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(self.state)
+            shard_leaves = jax.tree_util.tree_leaves(shardings)
+            multi = jax.process_count() > 1
+            new_leaves = []
+            for (path, cur), sharding in zip(leaves_with_path, shard_leaves):
+                key = _leaf_key(path)
+                arr = lookup(key)
+                if arr is None:
+                    skip = (not load_optimizer_states) and key.split(".")[0] in ("opt_state", "loss_scale")
+                    if not skip:
+                        logger.warning(f"universal load: no atom/passthrough for {key}; "
+                                       f"keeping current value")
+                    new_leaves.append(cur)
+                    continue
+                arr = fit(np.asarray(arr), cur, key)
+                if multi:
+                    new_leaves.append(jax.make_array_from_callback(
+                        tuple(arr.shape), sharding, lambda idx, a=arr: np.asarray(a[idx])))
+                else:
+                    new_leaves.append(jax.device_put(arr, sharding))
+            self.state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        client_state = data.get("client_state", {})
+        self.global_steps = client_state.get("global_steps", 0)
+        self.global_samples = client_state.get("global_samples", 0)
+        if "lr_scheduler" in client_state:
+            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        log_dist(f"loaded universal checkpoint from {udir} "
+                 f"({len(atoms)} parameter atoms, step={self.global_steps})", ranks=[0])
         return tag, client_state
 
     # ------------------------------------------------------------- utilities

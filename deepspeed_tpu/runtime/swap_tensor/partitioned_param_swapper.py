@@ -146,16 +146,30 @@ class SwappedLayerTrainer:
     def __init__(self, layer_fn: Callable, num_layers: int, head_fn: Callable,
                  swapper: AsyncPartitionedParameterSwapper,
                  lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
-                 weight_decay: float = 0.0, compute_dtype=jnp.bfloat16):
+                 weight_decay: float = 0.0, compute_dtype=jnp.bfloat16,
+                 stem_fn: Optional[Callable] = None,
+                 optimizer_device: str = "nvme"):
+        """``stem_fn(stem_params, x) -> hidden`` is the optional trainable input
+        transform (token embedding) ahead of the layer stack; its params stay
+        host-resident like the head's (the reference keeps embeddings persistent
+        via param_persistence_threshold).  ``optimizer_device``: "nvme" streams
+        Adam moments per layer alongside the params; "cpu" pins them in host RAM
+        (the reference's offload_optimizer: cpu + offload_param: nvme combo —
+        ZeRO-Infinity with moments one tier up, halving per-step disk traffic)."""
+        assert optimizer_device in ("nvme", "cpu")
         self.layer_fn = layer_fn
         self.num_layers = num_layers
         self.head_fn = head_fn
+        self.stem_fn = stem_fn
         self.swapper = swapper
         self.compute_dtype = compute_dtype
+        self.optimizer_device = optimizer_device
         from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
         self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
         self.step_count = 0
         self._layer_treedef = None
+        self._cpu_m: Optional[List[List[np.ndarray]]] = None  # [layer][leaf]
+        self._cpu_v: Optional[List[List[np.ndarray]]] = None
         self._fwd_jit = jax.jit(lambda p, x: self.layer_fn(p, x))
         # backward recompute, compiled: (params, x, cotangent) -> (dparams, dx)
         self._bwd_jit = jax.jit(lambda p, x, ct: jax.vjp(self.layer_fn, p, x)[1](ct))
@@ -163,23 +177,41 @@ class SwappedLayerTrainer:
         self._head_jit = jax.jit(
             lambda h, x, y: jax.value_and_grad(
                 lambda hh, xx: self.head_fn(hh, xx, y), argnums=(0, 1))(h, x))
+        if stem_fn is not None:
+            self._stem_jit = jax.jit(lambda sp, x: stem_fn(sp, x))
+            self._stem_bwd_jit = jax.jit(lambda sp, x, ct: jax.vjp(stem_fn, sp, x)[1](ct)[0])
 
     # ---------------------------------------------------------- initialize
-    def init_from_stacked(self, stacked_params: Any, head_params: Any):
+    def init_from_stacked(self, stacked_params: Any, head_params: Any,
+                          stem_params: Any = None):
         """Shard a [L, ...] stacked layer pytree onto NVMe (fp32 master +
-        zero moments per layer) and keep head params host-resident."""
+        zero moments per layer) and keep head/stem params host-resident.
+        One layer's worth of host copies at a time — broadcast-stacked or
+        memmap'd leaves never materialize in full."""
         leaves, self._layer_treedef = jax.tree_util.tree_flatten(stacked_params)
+        if self.optimizer_device == "cpu":
+            self._cpu_m = [None] * self.num_layers
+            self._cpu_v = [None] * self.num_layers
         for l in range(self.num_layers):
             layer = [np.asarray(leaf[l], np.float32) for leaf in leaves]
-            self.swapper.swap_out(self._pkey(l), layer, wait=False)
-            zeros = [np.zeros_like(a) for a in layer]
-            self.swapper.swap_out(self._mkey(l), zeros, wait=False)
-            self.swapper.swap_out(self._vkey(l), zeros, wait=False)
-        self.swapper.aio.wait_all()
+            rids = self.swapper.swap_out(self._pkey(l), layer, wait=False)
+            if self.optimizer_device == "cpu":
+                self._cpu_m[l] = [np.zeros_like(a) for a in layer]
+                self._cpu_v[l] = [np.zeros_like(a) for a in layer]
+            else:
+                zeros = [np.zeros_like(a) for a in layer]
+                rids += self.swapper.swap_out(self._mkey(l), zeros, wait=False)
+                rids += self.swapper.swap_out(self._vkey(l), zeros, wait=False)
+            # join per layer: unbounded in-flight writes would buffer every
+            # layer's source arrays (they're host views into the stacked tree)
+            for r in rids:
+                self.swapper.aio.wait(r)
         self.head = jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), head_params)
+        self.stem = (None if stem_params is None else
+                     jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), stem_params))
         n = sum(int(np.prod(np.shape(x))) for x in leaves)
         log_dist(f"param nvme swap: {self.num_layers} layers, {n/1e6:.2f}M stacked elems "
-                 f"on {self.swapper.dir}", ranks=[0])
+                 f"on {self.swapper.dir} (moments: {self.optimizer_device})", ranks=[0])
 
     def _pkey(self, l):
         return f"layer{l}.p"
@@ -197,7 +229,12 @@ class SwappedLayerTrainer:
     # ---------------------------------------------------------- train step
     def train_step(self, batch: Dict[str, np.ndarray], lr: Optional[float] = None):
         """One full fwd+bwd+update with layer streaming.  Returns the loss."""
-        x = jnp.asarray(batch["x"], self.compute_dtype)
+        if self.stem_fn is not None:
+            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
+            x_tokens = jnp.asarray(batch["x"])
+            x = self._stem_jit(stem_dev, x_tokens)
+        else:
+            x = jnp.asarray(batch["x"], self.compute_dtype)
         saved_inputs: List[np.ndarray] = [None] * self.num_layers
 
         # ---- forward: stream 0..L-1, double-buffered prefetch
@@ -230,14 +267,22 @@ class SwappedLayerTrainer:
         # ---- backward: stream L-1..0, recompute layer fwd, step immediately
         for l in reversed(range(self.num_layers)):
             host = self.swapper.wait_in(self._pkey(l))
+            if self.optimizer_device == "nvme":
+                # moments overlap this layer's recompute (prefetch now, join
+                # after the bwd_jit below)
+                self.swapper.swap_in_async(self._mkey(l))
+                self.swapper.swap_in_async(self._vkey(l))
             if l - 1 >= 0:
                 self.swapper.swap_in_async(self._pkey(l - 1))
             params_dev = self._device_params(host)
             x_in = jnp.asarray(saved_inputs[l], self.compute_dtype)
             dparams, dx = self._bwd_jit(params_dev, x_in, dx.astype(self.compute_dtype))
-            # stream this layer's optimizer state in, step, write back
-            m_host = self.swapper.wait_in(self._mkey(l))
-            v_host = self.swapper.wait_in(self._vkey(l))
+            # this layer's optimizer state: RAM-resident (cpu) or streamed (nvme)
+            if self.optimizer_device == "cpu":
+                m_host, v_host = self._cpu_m[l], self._cpu_v[l]
+            else:
+                m_host = self.swapper.wait_in(self._mkey(l))
+                v_host = self.swapper.wait_in(self._vkey(l))
             grads = [np.asarray(g, np.float32) for g in jax.tree_util.tree_leaves(dparams)]
             for p, m, v, g in zip(host, m_host, v_host, grads):
                 self.opt.step(p.ravel(), m.ravel(), v.ravel(), g.ravel(), lr=lr, step=step)
@@ -245,15 +290,29 @@ class SwappedLayerTrainer:
             # in-flight prefetch of layer l-1) before its buffers recycle: a
             # pooled buffer must not be overwritten mid-write, and the next
             # step's forward re-reads these files
-            rids = []
-            rids += self.swapper.swap_out(self._pkey(l), host, wait=False)
-            rids += self.swapper.swap_out(self._mkey(l), m_host, wait=False)
-            rids += self.swapper.swap_out(self._vkey(l), v_host, wait=False)
+            rids = self.swapper.swap_out(self._pkey(l), host, wait=False)
+            if self.optimizer_device == "nvme":
+                rids += self.swapper.swap_out(self._mkey(l), m_host, wait=False)
+                rids += self.swapper.swap_out(self._vkey(l), v_host, wait=False)
             for r in rids:
                 self.swapper.aio.wait(r)
             self.swapper.release(self._pkey(l))
-            self.swapper.release(self._mkey(l))
-            self.swapper.release(self._vkey(l))
+            if self.optimizer_device == "nvme":
+                self.swapper.release(self._mkey(l))
+                self.swapper.release(self._vkey(l))
+
+        # ---- stem (embedding) grads from the dx that reached layer 0's input
+        if self.stem_fn is not None:
+            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
+            dstem = self._stem_bwd_jit(stem_dev, x_tokens, dx.astype(self.compute_dtype))
+            flat_stem = jax.tree_util.tree_leaves(self.stem)
+            flat_dstem = jax.tree_util.tree_leaves(dstem)
+            if not hasattr(self, "_stem_m"):
+                self._stem_m = [np.zeros_like(a) for a in flat_stem]
+                self._stem_v = [np.zeros_like(a) for a in flat_stem]
+            for p, m, v, g in zip(flat_stem, self._stem_m, self._stem_v, flat_dstem):
+                self.opt.step(p.ravel(), m.ravel(), v.ravel(),
+                              np.asarray(g, np.float32).ravel(), lr=lr, step=step)
         return float(loss)
 
     def _head_grads(self, head_dev, x, batch):
@@ -262,7 +321,11 @@ class SwappedLayerTrainer:
 
     # ---------------------------------------------------------- inference
     def forward(self, x: np.ndarray):
-        x = jnp.asarray(x, self.compute_dtype)
+        if self.stem_fn is not None:
+            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
+            x = self._stem_jit(stem_dev, jnp.asarray(x))
+        else:
+            x = jnp.asarray(x, self.compute_dtype)
         self.swapper.swap_in_async(self._pkey(0))
         for l in range(self.num_layers):
             host = self.swapper.wait_in(self._pkey(l))

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Run BOTH test lanes (default + slow) and record the counts.
+
+VERDICT r3 weak #7 / next #9: the default lane deselects the deepest kernel
+parity tests (`pytest.ini` addopts `-m "not slow"`); this runner makes the
+full sweep one command and leaves a machine-readable artifact
+(TESTS_LANES.json) that bench.py folds into the bench output so every round's
+artifact shows both lanes' counts.
+
+Exit code is non-zero if EITHER lane fails.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import time
+
+
+def run_lane(name: str, marker_args):
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "-m", "pytest", "tests/", "-q", *marker_args],
+                          capture_output=True, text=True)
+    dt = time.time() - t0
+    tail = (proc.stdout.strip().splitlines() or [""])[-1]
+    counts = {k: int(v) for v, k in re.findall(r"(\d+) (passed|failed|error|skipped|deselected)", tail)}
+    print(f"[{name}] {tail}  ({dt:.0f}s)")
+    if proc.returncode != 0:
+        print(proc.stdout[-4000:])
+        print(proc.stderr[-2000:], file=sys.stderr)
+    return {"name": name, "rc": proc.returncode, "seconds": round(dt, 1),
+            "summary": tail, **counts}
+
+
+def main():
+    lanes = [run_lane("default", []), run_lane("slow", ["-m", "slow"])]
+    out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
+    with open("TESTS_LANES.json", "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps({"lanes": {l["name"]: l.get("passed", 0) for l in lanes}, "ok": out["ok"]}))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

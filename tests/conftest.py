@@ -32,6 +32,8 @@ def _reset_global_topology():
     yield
     from deepspeed_tpu.parallel import reset_topology
     reset_topology()
+    from deepspeed_tpu.models.transformer import set_default_attention
+    set_default_attention(None)
 
 
 @pytest.fixture
