@@ -83,15 +83,20 @@ def measure_collective_bw(n_bytes: int = 1 << 28, iters: int = 5):
         float(x[0])
         return (time.perf_counter() - t0) / reps
 
-    small, big = 1 << 27, 1 << 30
-    bws = []
+    # size from n_bytes so the CPU smoke probe stays a probe (4 MB, few reps)
+    # while the TPU leg streams enough to dominate the dispatch floor
+    big = max(n_bytes, 1 << 22)
+    small = max(big // 8, 1 << 19)
+    reps = 30 if big >= (1 << 28) else 5
+    bws, floors = [], []
     for _ in range(max(3, iters // 10)):
-        dt_s = timed_pass(small, 30)
-        dt_b = timed_pass(big, 30)
-        bws.append(2 * (big - small) / (dt_b - dt_s) / 1e9)
+        dt_s = timed_pass(small, reps)
+        dt_b = timed_pass(big, reps)
+        bws.append(2 * (big - small) / max(dt_b - dt_s, 1e-9) / 1e9)
+        floors.append(dt_s)
     return {"hbm_stream_gbps": round(float(np.median(bws)), 1),  # read + write
             "hbm_stream_fraction_of_spec": round(float(np.median(bws)) / 819.0, 3),
-            "hbm_dispatch_floor_ms": round(dt_s * 1e3, 2),
+            "hbm_dispatch_floor_ms": round(float(np.median(floors)) * 1e3, 2),
             "allgather_bucket_mb": round(big / 1e6, 1)}
 
 
@@ -257,16 +262,39 @@ def measure_training_longseq(on_tpu: bool):
     return out
 
 
+def _measure_h2d_mbps() -> float:
+    """Host->device link bandwidth (64 MB probe).  Real TPU hosts: PCIe,
+    GB/s.  The axon dev tunnel: a ~15-30 MB/s network relay — the binding
+    constraint for layer streaming, reported so the artifact explains the
+    step time."""
+    import jax
+    a = np.random.default_rng(0).random(16 * (1 << 20), np.float32)  # 64 MB
+    x = jax.device_put(a)
+    float(x[0])
+    t0 = time.perf_counter()
+    x = jax.device_put(a)
+    float(x[0])
+    return a.nbytes / (time.perf_counter() - t0) / 1e6
+
+
 def measure_training_infinity(on_tpu: bool):
-    """ZeRO-Infinity headline (VERDICT r3 #1): a 6.7B-param Llama-2-7B-shaped
-    model training REAL steps on ONE 16GB chip — 4.8x past the resident-state
-    HBM wall (1.4B) — via NVMe layer streaming (offload_param: nvme) with Adam
-    moments pinned in host RAM (offload_optimizer: cpu), all reached from
-    config alone.  Matches the reference's reach-beyond-HBM pitch
-    (partition_parameters.py:1479 + swap_tensor/partitioned_param_swapper.py:36).
+    """ZeRO-Infinity headline (VERDICT r3 #1): a Llama-2-7B-shaped model
+    (hidden 4096 x up to 32 layers) training REAL steps on ONE 16GB chip —
+    past the resident-state HBM wall (1.4B) — via NVMe layer streaming
+    (offload_param: nvme) with Adam moments pinned in host RAM
+    (offload_optimizer: cpu), all reached from config alone.  Matches the
+    reference's reach-beyond-HBM pitch (partition_parameters.py:1479 +
+    swap_tensor/partitioned_param_swapper.py:36).
+
+    The layer count ADAPTS to the measured host->device bandwidth so the leg
+    fits a time budget (BENCH_INFINITY_BUDGET_S, default 900): on real TPU
+    hosts (PCIe, GB/s) that resolves to the full 32-layer 6.74B model; through
+    the ~20 MB/s axon dev tunnel it resolves to a smaller depth, and the full
+    6.7B number comes from the offline artifact INFINITY_r04.json (produced by
+    benchmarks/run_infinity_7b.py) merged in below.
 
     Per-layer init uses broadcast-stacked leaves, so host memory stays at one
-    layer while 26 GB of fp32 master params shard onto disk."""
+    layer while up to 26 GB of fp32 master params shard onto disk."""
     if not on_tpu:
         return {"infinity": "skipped_on_cpu"}
     import gc
@@ -282,7 +310,13 @@ def measure_training_infinity(on_tpu: bool):
     from deepspeed_tpu.models import llama
     from deepspeed_tpu.models.transformer import cross_entropy_loss, rms_norm, rotary_tables
 
-    cfg = llama.LlamaConfig()  # llama2_7b shape: 4096 x 32L, 6.74B params
+    h2d_mbps = _measure_h2d_mbps()
+    budget_s = float(os.environ.get("BENCH_INFINITY_BUDGET_S", "900"))
+    # per layer per step: 2 uploads of 405 MB (bf16 compute copy, fwd + bwd)
+    # + ~1.6 s host AdamW (202M params) + ~2.3 s disk read+writeback
+    per_layer_s = 2 * 405.0 / max(h2d_mbps, 1.0) + 1.6 + 2.3
+    n_layers = int(min(32, max(2, budget_s / (2.2 * per_layer_s))))  # warm+timed+init slack
+    cfg = llama.LlamaConfig(num_layers=n_layers)  # llama2_7b shape at depth n_layers
     seq, micro = 2048, 1
     D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     H = cfg.num_heads
@@ -358,18 +392,35 @@ def measure_training_infinity(on_tpu: bool):
         loss = float(m.loss)
         if not np.isfinite(loss):
             return {"infinity": f"nonfinite loss {loss}"}
-        return {
+        out = {
             "infinity_params_b": round(n_params / 1e9, 2),
+            "infinity_layers": n_layers,
             "infinity_step_s": round(step_s, 1),
             "infinity_tok_s": round(micro * seq / step_s, 1),
             "infinity_warm_step_s": round(warm_s, 1),
             "infinity_init_s": round(init_s, 1),
             "infinity_loss": round(loss, 3),
             "infinity_placement": "params:nvme moments:cpu",
+            "infinity_h2d_link_mbps": round(h2d_mbps, 1),
             "infinity_vs_hbm_wall": round(n_params / 1e9 / 1.4026, 2),
         }
+        out.update(_infinity_offline())
+        return out
     finally:
         shutil.rmtree(nvme, ignore_errors=True)
+
+
+def _infinity_offline():
+    """Merge the offline full-6.7B run artifact (benchmarks/run_infinity_7b.py
+    -> INFINITY_r04.json) when present — the full-depth proof is hours through
+    the dev tunnel's ~20 MB/s host->device relay, so it runs once out-of-band
+    rather than inside every bench invocation."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "INFINITY_r04.json")
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    return {f"infinity_offline_{k}": v for k, v in data.items()}
 
 
 def measure_decode(on_tpu: bool):
@@ -482,7 +533,7 @@ def main():
     big = _leg(measure_training_big, on_tpu)
     longseq = _leg(measure_training_longseq, on_tpu)
     decode = _leg(measure_decode, on_tpu)
-    bw = _leg(measure_collective_bw, 1 << 28 if on_tpu else 1 << 22,
+    bw = _leg(measure_collective_bw, 1 << 30 if on_tpu else 1 << 22,
               50 if on_tpu else 5)
     fsdp = _leg(measure_fsdp_virtual) if on_tpu else {"fsdp_virtual8": "skipped_on_cpu"}
     infinity = _leg(measure_training_infinity, on_tpu)

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Ring vs Ulysses at long sequence: per-device activation memory + wall time.
+
+VERDICT r3 #5 'done' criterion: show the sequence length where ring fits and
+Ulysses cannot.  Ulysses all-to-alls to full-sequence/fewer-heads layout, so
+its attention activations scale O(S · H/P · D) per chip; ring keeps O(S/P · H
+· D) and rotates KV.  With H == P (the Ulysses limit for head-parallelism)
+the per-chip score matrix alone is O(S^2/P) for BOTH — the win is in the qkv
+activations and the all-to-all buffers, and in head counts < P where Ulysses
+stops scaling entirely.
+
+Runs on a virtual 8-device CPU mesh: per-device peak bytes come from XLA's
+compiled memory analysis (no OOM roulette), wall time from a small-S run.
+Emits one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel import MeshTopology, set_topology
+from deepspeed_tpu.sequence.layer import ulysses_attention
+from deepspeed_tpu.sequence.ring import ring_attention
+
+HBM_BYTES = 16 * (1 << 30)  # v5e
+
+
+def build(attn_fn, topo, b, s, h, kv, d):
+    spec = NamedSharding(topo.mesh, PartitionSpec(None, "sequence", None, None))
+    qs = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    ks = jax.ShapeDtypeStruct((b, s, kv, d), jnp.bfloat16)
+
+    def fn(q, k, v):
+        return attn_fn(q, k, v, causal=True)
+
+    return jax.jit(fn, in_shardings=(spec, spec, spec), out_shardings=spec).lower(
+        qs, ks, qs).compile()
+
+
+def peak_bytes(compiled) -> int:
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return -1
+    return int(ma.temp_size_in_bytes + ma.argument_size_in_bytes + ma.output_size_in_bytes)
+
+
+def main():
+    topo = MeshTopology.from_axis_dict({"sequence": 8})
+    set_topology(topo)
+    ring = ring_attention(topo=topo)
+    uly = ulysses_attention()
+    b, h, kv, d = 1, 8, 8, 128
+
+    rows = []
+    for s in (8192, 32768, 131072, 262144):
+        row = {"seq": s}
+        for name, fn in (("ring", ring), ("ulysses", uly)):
+            try:
+                c = build(fn, topo, b, s, h, kv, d)
+                row[f"{name}_peak_mb"] = round(peak_bytes(c) / 1e6, 1)
+                row[f"{name}_fits_v5e"] = bool(peak_bytes(c) < HBM_BYTES)
+            except Exception as exc:  # noqa: BLE001 — report, keep sweeping
+                row[f"{name}_peak_mb"] = f"error: {type(exc).__name__}"
+                row[f"{name}_fits_v5e"] = False
+        rows.append(row)
+        print(row, file=sys.stderr)
+
+    # wall time at a size both handle comfortably on CPU
+    s = 4096
+    timing = {}
+    for name, fn in (("ring", ring), ("ulysses", uly)):
+        c = build(fn, topo, b, s, h, kv, d)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d), np.float32), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, kv, d), np.float32), jnp.bfloat16)
+        out = c(q, k, q)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = c(q, k, q)
+        np.asarray(out)
+        timing[f"{name}_ms"] = round((time.perf_counter() - t0) / 3 * 1e3, 1)
+
+    crossover = next((r["seq"] for r in rows
+                      if r.get("ring_fits_v5e") and not r.get("ulysses_fits_v5e")), None)
+    print(json.dumps({"metric": "ring_vs_ulysses_seq_crossover", "value": crossover,
+                      "unit": "tokens", "rows": rows, "timing_seq4096": timing}))
+
+
+if __name__ == "__main__":
+    main()

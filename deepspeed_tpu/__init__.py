@@ -63,23 +63,22 @@ def initialize(args=None,
         tp_rules = getattr(model, "tp_rules", None)
 
     from .models import transformer as _transformer
+    # The reference swaps attention modules for SparseSelfAttention when the
+    # JSON's sparse_attention section is set (sparse_self_attention.py:99).
+    # Functionally: this engine's loss_fn is wrapped so the configured kernel
+    # (or explicitly None) is the default attention DURING ITS OWN TRACING —
+    # per-engine scoping, so a second initialize() in the same process can
+    # neither inherit nor clobber another engine's attention math.
+    sparse_fn = None
     if cfg.sparse_attention is not None:
-        # The reference swaps attention modules for SparseSelfAttention when the
-        # JSON's sparse_attention section is set (sparse_self_attention.py:99);
-        # functionally, install the blocksparse kernel as the process-wide
-        # default attention_fn — models built on models.transformer.attention_block
-        # pick it up at trace time (opaque loss_fns that don't are unaffected).
         from .ops.sparse_attention.attention import make_config_attention_fn
         from .utils.logging import log_dist
-        _transformer.set_default_attention(make_config_attention_fn(cfg.sparse_attention))
-        log_dist(f"sparse_attention: installed blocksparse kernel "
+        sparse_fn = make_config_attention_fn(cfg.sparse_attention)
+        log_dist(f"sparse_attention: blocksparse kernel "
                  f"(mode={cfg.sparse_attention.mode}, block={cfg.sparse_attention.block}) "
-                 f"as the default attention_fn for models routed through "
-                 f"models.transformer.attention_block", ranks=[0])
-    else:
-        # a previous initialize() in this process may have installed one; this
-        # engine's config didn't ask for it — clear, don't leak
-        _transformer.set_default_attention(None)
+                 f"is this engine's default attention_fn for models routed "
+                 f"through models.transformer.attention_block", ranks=[0])
+    fn = _transformer.scoped_default_attention(fn, sparse_fn)
 
     engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules,
                     param_init_fn=param_init_fn,

@@ -83,6 +83,16 @@ def ds_to_universal(ckpt_dir: str, out_dir: str, strip_vocab_padding: Optional[i
         atoms = {PARAM_ATOM: list(arr.shape)}
         for name, mk in sorted(atom_map[ppath].items()):
             marr = np.load(os.path.join(ckpt_dir, mk + ".npy"))
+            if stripped and marr.dtype == np.int8:
+                # quantized moments (fused_adam8bit) store flat (groups,
+                # group_size) blocks — dim 0 is GROUPS, not the vocab dim, so
+                # a row-strip here would silently desync moments from the
+                # stripped param (ADVICE r3 #2).  Refuse rather than corrupt.
+                raise ValueError(
+                    f"--strip-vocab-padding cannot re-layout quantized int8 moment "
+                    f"atom {mk} ({ppath}): dequantize first (load with "
+                    f"fused_adam8bit, re-save with adamw) or convert without "
+                    f"stripping")
             # cast float atoms to fp32 (universal format contract); keep
             # integer/bool aux leaves (e.g. step counters) in their dtype
             if np.issubdtype(marr.dtype, np.floating):
@@ -105,6 +115,9 @@ def ds_to_universal(ckpt_dir: str, out_dir: str, strip_vocab_padding: Optional[i
             passthrough[k] = True
     with open(os.path.join(out_dir, "universal_metadata.json"), "w") as fh:
         json.dump({"version": 1, "params": index, "passthrough": sorted(passthrough),
+                   # recorded so loaders re-pad ONLY genuinely stripped atoms
+                   # (a bare dim-0 mismatch must stay a hard error)
+                   "strip_vocab_padding": strip_vocab_padding,
                    "client_state": meta.get("client_state", {})}, fh, indent=1)
     log_dist(f"universal checkpoint: {len(index)} parameter atoms -> {out_dir}", ranks=[0])
     return out_dir
@@ -121,6 +134,7 @@ def load_universal(universal_dir: str) -> Dict[str, Any]:
         out[ppath] = {name: np.load(os.path.join(adir, name + ".npy"))
                       for name in atoms}
     return {"params": out, "client_state": meta.get("client_state", {}),
+            "strip_vocab_padding": meta.get("strip_vocab_padding"),
             "passthrough": {k: np.load(os.path.join(universal_dir, k + ".npy"))
                             for k in meta.get("passthrough", [])}}
 

@@ -49,11 +49,14 @@ class InferenceEngineV2:
             from . import tp as _tp
             if "tp_axis" not in inspect.signature(model_module.forward_paged).parameters:
                 raise NotImplementedError(
-                    f"{model_module.__name__}.forward_paged has no tp_axis support yet; "
-                    f"TP v2 serving covers llama/mistral/mixtral")
-            _tp.validate_model(model_config, self.tp)
-            self._param_specs = _tp.param_specs(model_module, params, self.tp)
-            self._kv_specs = _tp.kv_pool_spec(kv)
+                    f"{model_module.__name__}.forward_paged has no tp_axis support; "
+                    f"all built-in paged families (llama/mistral/mixtral/opt/falcon/"
+                    f"phi/qwen) ship it — thread tp_axis through custom models the "
+                    f"same way (psum after row-parallel projections)")
+            _tp.validate_model(model_config, self.tp, model_module=model_module)
+            self._param_specs = _tp.param_specs(model_module, params, self.tp,
+                                                model_config=model_config)
+            self._kv_specs = _tp.kv_pool_spec(kv, self.tp)
             params = _tp.place(topology, params, self._param_specs)
             kv = _tp.place(topology, kv, self._kv_specs)
         self.params = params
@@ -136,10 +139,12 @@ class InferenceEngineV2:
         fwd = self._compiled_fwd(n, t, b)
         logits, self.kv = fwd(self.params, self.kv, jnp.asarray(tokens), jnp.asarray(n_tokens),
                               jnp.asarray(start_pos), jnp.asarray(tables))
-        # last valid position of each chunk
-        last = np.maximum(n_tokens - 1, 0)
-        last_logits = np.asarray(jnp.take_along_axis(
-            logits, jnp.asarray(last)[:, None, None], axis=1)[:, 0])
+        # token selection runs ON DEVICE (argmax or temperature/top-k/top-p
+        # sampling) — only n ints cross the host link, not [n, V] logits
+        # (reference: ragged sampling stays device-side, engine_v2.py:107)
+        pick = self._compiled_step_pick(n, greedy)
+        toks_dev, self._rng = pick(logits, jnp.asarray(np.maximum(n_tokens - 1, 0)), self._rng)
+        toks = np.asarray(toks_dev)
 
         out: Dict[int, int] = {}
         for i, c in enumerate(chunks):
@@ -147,76 +152,123 @@ class InferenceEngineV2:
             seq.seen_tokens += c.n_tokens
             if seq.seen_tokens >= len(seq.tokens):
                 # produced a next token (end of prompt, or a decode step)
-                if greedy:
-                    tok = int(np.argmax(last_logits[i]))
-                else:
-                    from ..engine import _sample
-                    toks, self._rng = _sample(jnp.asarray(last_logits[i:i + 1]), self._rng,
-                                              temperature=self.config.temperature,
-                                              top_k=self.config.top_k, top_p=self.config.top_p)
-                    tok = int(toks[0])
+                tok = int(toks[i])
                 seq.tokens.append(tok)
                 out[c.uid] = tok
         return out
 
-    # ------------------------------------------------------------ decode burst
-    def _compiled_burst(self, n: int, k: int):
-        key = ("burst", n, k)
+    def _compiled_step_pick(self, n: int, greedy: bool):
+        key = ("pick", n, greedy, self.config.temperature, self.config.top_k,
+               self.config.top_p)
         if key not in self._fwd_cache:
+            from ..engine import _sample
+            temperature, top_k, top_p = (self.config.temperature, self.config.top_k,
+                                         self.config.top_p)
+
+            def pick(logits, last, rng):
+                row = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0]
+                if greedy:
+                    return jnp.argmax(row, axis=-1).astype(jnp.int32), rng
+                return _sample(row, rng, temperature=temperature, top_k=top_k, top_p=top_p)
+
+            self._fwd_cache[key] = jax.jit(pick)
+        return self._fwd_cache[key]
+
+    # ------------------------------------------------------------ decode burst
+    def _compiled_burst(self, n: int, k: int, sample_cfg=None, eos: int = -1):
+        """``sample_cfg``: None => greedy; (temperature, top_k, top_p) =>
+        on-device sampling with the rng carried through the scan.  ``eos`` >= 0
+        makes decode eos-aware: a finished row freezes (re-emits its token) and
+        its done flag streams out alongside the tokens."""
+        key = ("burst", n, k, sample_cfg, eos)
+        if key not in self._fwd_cache:
+            from ..engine import _sample
             model, cfg, bs = self.model, self.model_config, self.block_size
             ones = jnp.ones((n, ), jnp.int32)
+            sampling = sample_cfg is not None
             if self.tp > 1:
-                # vocab-parallel greedy: argmax the LOCAL logit shard and reduce
-                # (max value, then first-occurrence index) with O(1) scalars per
-                # token over ICI instead of all-gathering O(V) logits each step
                 tp_kw = {"tp_axis": TENSOR_AXIS, "gather_logits": False}
                 vocab = getattr(cfg, "vocab_size", None)
 
-                def pick(row):  # row [N, V_local]
+                def full_logits(row):  # [N, V_local] -> [N, V]
                     if vocab is not None and row.shape[-1] == vocab:
-                        return jnp.argmax(row, axis=-1).astype(jnp.int32)  # tied head: full V
-                    vlocal = row.shape[-1]
-                    local_idx = jnp.argmax(row, axis=-1).astype(jnp.int32)
-                    local_val = jnp.max(row, axis=-1)
-                    best = jax.lax.pmax(local_val, TENSOR_AXIS)
-                    offset = jax.lax.axis_index(TENSOR_AXIS).astype(jnp.int32) * vlocal
-                    cand = jnp.where(local_val == best, local_idx + offset,
-                                     jnp.int32(2**31 - 1))
-                    return jax.lax.pmin(cand, TENSOR_AXIS).astype(jnp.int32)
+                        return row  # tied/replicated head: already full
+                    return jax.lax.all_gather(row, TENSOR_AXIS, axis=-1, tiled=True)
+
+                if sampling:
+                    # sampling needs the full distribution: gather O(V) logits
+                    # over ICI, then sample with the REPLICATED rng so every
+                    # shard picks the identical token
+                    temperature, top_k, top_p = sample_cfg
+
+                    def pick(row, rng):
+                        return _sample(full_logits(row), rng, temperature=temperature,
+                                       top_k=top_k, top_p=top_p)
+                else:
+                    # vocab-parallel greedy: argmax the LOCAL logit shard and
+                    # reduce (max value, then first-occurrence index) with O(1)
+                    # scalars per token over ICI instead of O(V) gathers
+                    def pick(row, rng):  # row [N, V_local]
+                        if vocab is not None and row.shape[-1] == vocab:
+                            return jnp.argmax(row, axis=-1).astype(jnp.int32), rng
+                        vlocal = row.shape[-1]
+                        local_idx = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                        local_val = jnp.max(row, axis=-1)
+                        best = jax.lax.pmax(local_val, TENSOR_AXIS)
+                        offset = jax.lax.axis_index(TENSOR_AXIS).astype(jnp.int32) * vlocal
+                        cand = jnp.where(local_val == best, local_idx + offset,
+                                         jnp.int32(2**31 - 1))
+                        return jax.lax.pmin(cand, TENSOR_AXIS).astype(jnp.int32), rng
             else:
                 tp_kw = {}
-                pick = lambda row: jnp.argmax(row, axis=-1).astype(jnp.int32)
+                if sampling:
+                    temperature, top_k, top_p = sample_cfg
 
-            def burst(params, kv, tok0, start0, tables):
+                    def pick(row, rng):
+                        return _sample(row, rng, temperature=temperature,
+                                       top_k=top_k, top_p=top_p)
+                else:
+                    pick = lambda row, rng: (jnp.argmax(row, axis=-1).astype(jnp.int32), rng)
+
+            def burst(params, kv, tok0, start0, tables, rng0, done0):
                 def body(carry, _):
-                    kv, tok, start = carry
+                    kv, tok, start, rng, done = carry
                     logits, kv = model.forward_paged(cfg, params, tok[:, None], ones,
                                                      start, tables, kv, block_size=bs,
                                                      **tp_kw)
-                    nxt = pick(logits[:, 0])
-                    return (kv, nxt, start + 1), nxt
+                    nxt, rng = pick(logits[:, 0], rng)
+                    # finished rows freeze: re-emit the last token (the pool
+                    # keeps absorbing writes into pre-allocated slots; the host
+                    # truncates at the first done flag)
+                    nxt = jnp.where(done, tok, nxt)
+                    done = jnp.logical_or(done, nxt == jnp.int32(eos))
+                    return (kv, nxt, start + 1, rng, done), (nxt, done)
 
-                (kv, _, _), toks = jax.lax.scan(body, (kv, tok0, start0), None, length=k)
-                return kv, toks  # toks [K, N]
+                (kv, _, _, _, _), (toks, dones) = jax.lax.scan(
+                    body, (kv, tok0, start0, rng0, done0), None, length=k)
+                return kv, toks, dones  # [K, N] each
 
             if self.tp > 1:
-                burst = self._shard_mapped(burst, (self._kv_specs, PartitionSpec()))
+                burst = self._shard_mapped(
+                    burst, (self._kv_specs, PartitionSpec(), PartitionSpec()))
             self._fwd_cache[key] = jax.jit(burst, donate_argnums=(1, ))
         return self._fwd_cache[key]
 
-    def decode_burst(self, k: int, greedy: bool = True) -> Optional[Dict[int, List[int]]]:
-        """Run ``k`` greedy decode steps INSIDE one compiled program — one host
+    def decode_burst(self, k: int, greedy: bool = True,
+                     eos_token_id: Optional[int] = None) -> Optional[Dict[int, List[int]]]:
+        """Run ``k`` decode steps INSIDE one compiled program — one host
         round-trip per k tokens instead of per token (the latency lever the
         reference gets from CUDA-graph decode loops; on a remote-relay
         transport this is the difference between ~4 and ~100+ tok/s/seq).
 
-        Applies only when every live sequence is in pure decode (one pending
-        token) and the pool can pre-allocate k more slots per sequence;
-        returns None when not applicable (caller falls back to step()).
-        Sampling/eos-aware serving uses step() — burst is greedy.
+        Greedy AND sampled (temperature/top-k/top-p from the engine config)
+        decode both run device-side; with ``eos_token_id`` the scan carries a
+        done-mask and finished rows freeze, so the returned per-uid lists stop
+        at (and include) the first eos.  Applies only when every live sequence
+        is in pure decode (one pending token) and the pool can pre-allocate k
+        more slots per sequence; returns None when not applicable (caller
+        falls back to step()).
         """
-        if not greedy:
-            return None
         live = [s for s in self.manager.seqs.values()
                 if not s.done and s.pending_tokens > 0]
         if not live or any(s.pending_tokens != 1 for s in live):
@@ -251,41 +303,57 @@ class InferenceEngineV2:
             start0[i] = seq.seen_tokens
             tables[i] = self.manager.block_table_row(seq)[:b]
         # padded rows: decode into the trash block at position 0
-        burst = self._compiled_burst(n, k)
-        self.kv, toks = burst(self.params, self.kv, jnp.asarray(tok0),
-                              jnp.asarray(start0), jnp.asarray(tables))
-        toks = np.asarray(toks)  # [K, N]
+        sample_cfg = None if greedy else (self.config.temperature, self.config.top_k,
+                                          self.config.top_p)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        burst = self._compiled_burst(n, k, sample_cfg=sample_cfg, eos=eos)
+        self._rng, sub = jax.random.split(self._rng)
+        done0 = jnp.zeros((n, ), jnp.bool_)
+        self.kv, toks, dones = burst(self.params, self.kv, jnp.asarray(tok0),
+                                     jnp.asarray(start0), jnp.asarray(tables), sub, done0)
+        toks = np.asarray(toks)    # [K, N]
+        dones = np.asarray(dones)  # [K, N]
         out: Dict[int, List[int]] = {}
         for i, seq in enumerate(live):
-            produced = [int(t) for t in toks[:, i]]
+            col = toks[:, i]
+            n_real = k
+            if eos >= 0 and dones[:, i].any():
+                n_real = int(np.argmax(dones[:, i])) + 1  # first done step, inclusive
+            produced = [int(t) for t in col[:n_real]]
             seq.tokens.extend(produced)
-            seq.seen_tokens += k
+            seq.seen_tokens += n_real
             out[seq.uid] = produced
         return out
 
     # ----------------------------------------------------------- convenience
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None) -> List[List[int]]:
-        """Serve a batch to completion through the continuous-batching loop."""
+                 eos_token_id: Optional[int] = None, greedy: bool = True) -> List[List[int]]:
+        """Serve a batch to completion through the continuous-batching loop.
+
+        ``greedy=False`` samples with the engine config's temperature/top-k/
+        top-p — still through the device-side burst (the scan carries the rng
+        and an eos done-mask), so sampled serving runs at burst throughput
+        rather than the one-host-roundtrip-per-token relay floor."""
         uids = list(range(len(prompts)))
         self.put(uids, prompts)
         produced = {u: 0 for u in uids}
         done = set()
         while len(done) < len(uids):
-            # pure-decode fast path (greedy, no eos): burst k steps on device
-            if eos_token_id is None:
-                live = [u for u in uids if u not in done]
-                k = min((max_new_tokens - produced[u] for u in live), default=0)
-                if k >= 2:
-                    burst = self.decode_burst(k)
-                    if burst:
-                        for uid, toks in burst.items():
-                            produced[uid] += len(toks)
-                            if produced[uid] >= max_new_tokens:
-                                self.manager.seqs[uid].done = True
-                                done.add(uid)
-                        continue
-            stepped = self.step()
+            # pure-decode fast path: burst k steps on device (greedy or
+            # sampled; eos-aware via the carried done-mask)
+            live = [u for u in uids if u not in done]
+            k = min((max_new_tokens - produced[u] for u in live), default=0)
+            if k >= 2:
+                burst = self.decode_burst(k, greedy=greedy, eos_token_id=eos_token_id)
+                if burst:
+                    for uid, toks in burst.items():
+                        produced[uid] += len(toks)
+                        hit_eos = eos_token_id is not None and toks and toks[-1] == eos_token_id
+                        if hit_eos or produced[uid] >= max_new_tokens:
+                            self.manager.seqs[uid].done = True
+                            done.add(uid)
+                    continue
+            stepped = self.step(greedy=greedy)
             for uid, reason in list(self.manager.failures.items()):
                 if uid not in done:
                     raise RuntimeError(f"request {uid} failed: {reason}")

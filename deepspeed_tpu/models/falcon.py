@@ -111,21 +111,51 @@ def init_paged_cache(config: FalconConfig, num_blocks: int, block_size: int, dty
                               num_blocks, block_size, dtype)
 
 
+def make_tp_rules(config: FalconConfig):
+    """v2 TP layout (reference inference/v2/model_implementations/sharding/
+    used by the falcon containers): wq/fc1 column-parallel, wo/fc2
+    row-parallel, norms/embed replicated.  MQA (num_kv_heads == 1, falcon-7b):
+    wk/wv and the KV pool REPLICATE — every shard computes the same single KV
+    head (the reference's KV-replication fallback in sharding/qkv.py); GQA
+    40B-style (kv > 1) shards them when divisible."""
+    kv = config.num_kv_heads
+
+    def rules(path: str, shape) -> "int | None":
+        if path.endswith(("wq", "fc1")):
+            return 2
+        if path.endswith(("wk", "wv")):
+            return 2 if kv > 1 else None
+        if path.endswith(("wo", "fc2")):
+            return 1
+        return None
+
+    return rules
+
+
 def forward_paged(config: FalconConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
     """Ragged chunked Falcon forward — MQA KV pool (1 KV head) through the
-    Pallas paged kernel's GQA head mapping."""
+    Pallas paged kernel's GQA head mapping.
+
+    ``tp_axis``: q heads shard; MQA's single KV head (and its pool) replicates
+    across shards — each computes the identical k/v, the GQA mapping folds all
+    local q heads onto it.  The parallel-residual psum covers attn+mlp in ONE
+    reduction (attn_out + mlp_out summed before the psum).  Tied unembed keeps
+    full-vocab logits (gather_logits accepted for the engine's convention)."""
     from ..ops.attention.paged import paged_attention
 
     b, tchunk = tokens.shape
-    H, KV = config.num_heads, config.num_kv_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads  # TP-invariant
+    H = params["layers"]["wq"].shape[-1] // Dh   # local q heads
+    KV = kv_cache["k"].shape[2]                  # local kv heads (replicated MQA: full)
     scale = 1.0 / np.sqrt(Dh)
     cos, sin = rotary_tables(Dh, config.max_seq_len, config.rope_theta)
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     head_idx = jnp.arange(KV)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -142,7 +172,7 @@ def forward_paged(config: FalconConfig, params, tokens, n_tokens, start_pos, blo
         attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)
         mlp_out = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype),
                               approximate=False) @ lp["fc2"].astype(x.dtype)
-        return x + attn_out + mlp_out, (kpool, vpool)
+        return x + preduce(attn_out + mlp_out), (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)

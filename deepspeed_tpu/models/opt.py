@@ -118,20 +118,46 @@ def init_paged_cache(config: OPTConfig, num_blocks: int, block_size: int, dtype=
                               num_blocks, block_size, dtype)
 
 
+def tp_rules(path: str, shape) -> "int | None":
+    """v2 TP layout (reference inference/v2/model_implementations/sharding/):
+    qkv + fc1 column-parallel WITH their biases; wo/fc2 row-parallel with
+    replicated biases (added once, after the psum); embeddings/norms replicated
+    (tied unembed keeps full-vocab logits on every shard)."""
+    if path.endswith(("bo", "b_fc2")):
+        return None  # row-parallel biases replicate (added once, post-psum)
+    if path.endswith(("bq", "bk", "bv", "b_fc1")):
+        return 1  # [L, out] -> shard with the matching column weight
+    # bias checks precede weights: "b_fc1"/"b_fc2" suffix-match "fc1"/"fc2"
+    if path.endswith(("wq", "wk", "wv", "fc1")):
+        return 2  # [L, in, out] -> shard out
+    if path.endswith(("wo", "fc2")):
+        return 1  # [L, in, out] -> shard in
+    return None
+
+
 def forward_paged(config: OPTConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
-    """Ragged chunked OPT forward (learned positions — no rotary on K/Q)."""
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
+    """Ragged chunked OPT forward (learned positions — no rotary on K/Q).
+
+    ``tp_axis``: inside shard_map with params sharded per tp_rules, names the
+    mesh axis to psum row-parallel partials over.  Row-parallel biases (bo,
+    b_fc2) are replicated and added AFTER the psum so they count once.  Local
+    head counts derive from the shard shapes; the tied unembedding is
+    replicated, so logits are always full-vocab (gather_logits is a no-op,
+    accepted for the engine's uniform calling convention)."""
     from ..ops.attention.paged import paged_attention
 
     b, tchunk = tokens.shape
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
-    H = config.num_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads  # TP-invariant head dim
+    H = params["layers"]["wq"].shape[-1] // Dh   # local (per-shard) heads
     scale = 1.0 / np.sqrt(Dh)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     x = x + params["pos_embed"][safe_pos + POS_OFFSET].astype(x.dtype)
     head_idx = jnp.arange(H)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -143,10 +169,11 @@ def forward_paged(config: OPTConfig, params, tokens, n_tokens, start_pos, block_
         vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
         out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
                               block_size=block_size, softmax_scale=scale)
-        x = x + out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+        x = x + preduce(out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)) \
+            + lp["bo"].astype(x.dtype)
         h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], config.ln_eps)
         h = jax.nn.relu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype))
-        x = x + h @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+        x = x + preduce(h @ lp["fc2"].astype(x.dtype)) + lp["b_fc2"].astype(x.dtype)
         return x, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))

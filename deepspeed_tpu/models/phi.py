@@ -130,20 +130,49 @@ def init_paged_cache(config: PhiConfig, num_blocks: int, block_size: int, dtype=
                               num_blocks, block_size, dtype)
 
 
+def tp_rules(path: str, shape) -> "int | None":
+    """v2 TP layout (reference inference/v2/model_implementations/sharding/
+    used by the phi containers): qkv + fc1 column-parallel with their biases;
+    wo/fc2 row-parallel with replicated biases (added once after the psum);
+    untied lm_head vocab-parallel with its bias sharded alongside."""
+    if path.endswith(("bo", "b_fc2")):
+        return None  # row-parallel biases replicate (added once, post-psum)
+    if path.endswith(("bq", "bk", "bv", "b_fc1")):
+        return 1
+    # bias checks precede weights: "b_fc1"/"b_fc2" suffix-match "fc1"/"fc2"
+    if path.endswith(("wq", "wk", "wv", "fc1")):
+        return 2
+    if path.endswith(("wo", "fc2")):
+        return 1
+    if path == "lm_head":
+        return 1  # [D, V] vocab-parallel
+    if path == "lm_head_b":
+        return 0  # [V] sharded with its vocab slice
+    return None
+
+
 def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
-    """Ragged chunked Phi forward — partial rotary feeds the paged kernel."""
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
+    """Ragged chunked Phi forward — partial rotary feeds the paged kernel.
+
+    ``tp_axis``: heads shard; the parallel residual's attn+mlp partials reduce
+    in ONE psum with the replicated bo/b_fc2 added after it.  The untied
+    lm_head is vocab-parallel: the local bias slice lands on local logits
+    before the (optional) gather, so greedy decode can argmax the local shard
+    (gather_logits=False) without moving O(V) over ICI."""
     from ..ops.attention.paged import paged_attention
 
     b, tchunk = tokens.shape
-    H = config.num_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads  # TP-invariant
+    H = params["layers"]["wq"].shape[-1] // Dh   # local heads
     scale = 1.0 / np.sqrt(Dh)
     cos, sin = rotary_tables(config.rotary_dim, config.max_seq_len, config.rope_theta)
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
     head_idx = jnp.arange(H)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -157,15 +186,19 @@ def forward_paged(config: PhiConfig, params, tokens, n_tokens, start_pos, block_
         vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
         out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
                               block_size=block_size, softmax_scale=scale)
-        attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype) + lp["bo"].astype(x.dtype)
+        attn_out = out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)
         mlp = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype),
                           approximate=True)
-        mlp_out = mlp @ lp["fc2"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
-        return x + attn_out + mlp_out, (kpool, vpool)
+        mlp_out = mlp @ lp["fc2"].astype(x.dtype)
+        x = x + preduce(attn_out + mlp_out) \
+            + lp["bo"].astype(x.dtype) + lp["b_fc2"].astype(x.dtype)
+        return x, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
     logits = x @ params["lm_head"].astype(x.dtype) + params["lm_head_b"].astype(x.dtype)
+    if tp_axis is not None and gather_logits:
+        logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
     return logits, {"k": new_k, "v": new_v}
 
 

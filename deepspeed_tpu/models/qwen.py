@@ -99,12 +99,24 @@ def make_loss_fn(config: QwenConfig, attention_fn=None) -> Callable:
 
 causal_lm_batch = llama.causal_lm_batch
 init_paged_cache = llama.init_paged_cache
-tp_rules = llama.tp_rules
+
+
+def tp_rules(path: str, shape) -> "int | None":
+    """Llama's column/row layout + qwen's qkv biases sharded with their
+    column-parallel weights ([L, out] -> dim 1)."""
+    if path.endswith(("attn.bq", "attn.bk", "attn.bv")):
+        return 1
+    return llama.tp_rules(path, shape)
 
 
 def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block_tables,
-                  kv_cache, *, block_size: int):
-    """Ragged chunked Qwen2 forward: llama's paged layer + qkv bias adds."""
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
+    """Ragged chunked Qwen2 forward: llama's paged layer + qkv bias adds.
+
+    ``tp_axis`` threads TP exactly like llama.forward_paged (head-sharded
+    KV pool, psum after row-parallel wo/w_down, vocab-parallel lm_head);
+    the qkv biases ride their column-parallel weights' shards."""
     from ..ops.attention.paged import paged_attention
 
     b, tchunk = tokens.shape
@@ -113,10 +125,12 @@ def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block
     safe_pos, valid, lengths, blk, off = paged_chunk_indices(
         tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
     x = params["embed"][tokens].astype(kv_cache["k"].dtype)
-    H, KV = config.num_heads, config.num_kv_heads
-    Dh = config.hidden_size // H
+    Dh = config.hidden_size // config.num_heads            # TP-invariant
+    H = params["layers"]["attn"]["wq"].shape[-1] // Dh     # local heads
+    KV = params["layers"]["attn"]["wk"].shape[-1] // Dh
     scale = 1.0 / np.sqrt(Dh)
     head_idx = jnp.arange(KV)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
 
     def layer(x, inp):
         lp, kpool, vpool = inp
@@ -131,15 +145,17 @@ def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block
         vpool = vpool.at[blk[:, :, None], head_idx, off[:, :, None]].set(v)
         out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
                               block_size=block_size, softmax_scale=scale)
-        x = x + out.reshape(b, tchunk, H * Dh) @ a["wo"].astype(x.dtype)
+        x = x + preduce(out.reshape(b, tchunk, H * Dh) @ a["wo"].astype(x.dtype))
         mlp_in = rms_norm(x, lp["mlp_norm"], config.rms_eps)
-        x = x + swiglu_mlp(lp["mlp"], mlp_in)
+        x = x + preduce(swiglu_mlp(lp["mlp"], mlp_in))
         return x, (kpool, vpool)
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = x @ head.astype(x.dtype)
+    if tp_axis is not None and gather_logits and not config.tie_embeddings:
+        logits = jax.lax.all_gather(logits, tp_axis, axis=-1, tiled=True)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -117,6 +117,24 @@ def set_default_attention(fn):
     _CONFIGURED_ATTENTION["engaged"] = False
 
 
+def scoped_default_attention(loss_fn, attention_fn):
+    """Wrap ``loss_fn`` so ``attention_fn`` (possibly None) is the configured
+    default exactly while loss_fn's body runs — i.e. while jit TRACES it.
+    This pins each engine's attention choice to its own loss function: two
+    engines with different sparse_attention configs coexist in one process,
+    and an engine that configured none can never inherit another's kernel."""
+
+    def scoped(*args, **kwargs):
+        prev = _CONFIGURED_ATTENTION["fn"]
+        _CONFIGURED_ATTENTION["fn"] = attention_fn
+        try:
+            return loss_fn(*args, **kwargs)
+        finally:
+            _CONFIGURED_ATTENTION["fn"] = prev
+
+    return scoped
+
+
 def configured_attention_engaged() -> bool:
     return _CONFIGURED_ATTENTION["engaged"]
 

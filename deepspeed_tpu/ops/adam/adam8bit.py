@@ -10,6 +10,11 @@ difference between 770M and ~1.4B fitting on one 16GB v5e chip.
 
 Quantization scheme (per group of ``group_size`` elements, one fp32 scale):
 - ``m`` (first moment, signed): symmetric abs-max int8 in [-127, 127].
+  Known limit (ADVICE r3 #5): linear coding flushes |m| < absmax/254 within a
+  group each requant — outlier-heavy groups lose small-momentum signal.  The
+  300-step convergence test (test_optimizers.py) bounds the practical impact;
+  a bitsandbytes-style nonlinear code or smaller groups is the upgrade path
+  if longer horizons drift.
 - ``v`` (second moment, non-negative): stored in the **sqrt domain** —
   ``u = sqrt(v)`` quantized abs-max to [0, 127].  Linear int8 on raw ``v``
   zeroes everything below absmax/127 and the resulting 1/(sqrt(0)+eps) updates

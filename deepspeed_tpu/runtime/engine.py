@@ -308,7 +308,8 @@ class Engine:
                                       weight_decay=float(opt_params.get("weight_decay", 0.0)),
                                       compute_dtype=self.compute_dtype,
                                       stem_fn=stem_fn,
-                                      optimizer_device=opt_device)
+                                      optimizer_device=opt_device,
+                                      offload_activations=self.config.activation_checkpointing.cpu_checkpointing)
         # "stem" is reserved ONLY when a stem_fn claims it; without one it
         # stays in the head params (e.g. head_fn reading params["stem"])
         head_keys = ("layers", "stem") if stem_fn is not None else ("layers", )
@@ -908,6 +909,7 @@ class Engine:
                     " — convert a checkpoint first (python -m deepspeed_tpu.checkpoint.universal)")
         data = load_universal(udir)
         atoms, passthrough = data["params"], data["passthrough"]
+        stripped_to = data.get("strip_vocab_padding")
         by_len = sorted(atoms, key=len, reverse=True)
 
         def lookup(key: str):
@@ -929,7 +931,12 @@ class Engine:
         def fit(arr, cur, key):
             want = tuple(np.shape(cur))
             if tuple(arr.shape) != want:
-                if (arr.ndim == len(want) and arr.ndim >= 1 and arr.shape[0] < want[0]
+                # re-pad ONLY atoms the converter recorded as vocab-stripped
+                # (strip_vocab_padding in universal_metadata.json) — a bare
+                # dim-0 mismatch (e.g. different layer count) must stay a hard
+                # error, not silently zero-filled "layers"
+                if (stripped_to is not None and arr.ndim == len(want) and arr.ndim >= 1
+                        and arr.shape[0] == stripped_to and arr.shape[0] < want[0]
                         and tuple(arr.shape[1:]) == tuple(want[1:])):
                     pad = np.zeros((want[0] - arr.shape[0], ) + tuple(arr.shape[1:]), arr.dtype)
                     arr = np.concatenate([arr, pad], axis=0)
@@ -942,27 +949,35 @@ class Engine:
 
         if self.offload_device is not None:
             # host-offloaded Adam: atoms land in the host buffers via the same
-            # state_dict path the native offload resume uses
+            # state_dict path the native offload resume uses.  load_state_dict
+            # consumes EVERY key's m AND v, so unmatched moments must be filled
+            # from the current state (not omitted — a partial dict KeyErrors)
             template = lambda shape: np.empty(shape, np.float32)
+            cur = self._offload_state.state_dict() if load_optimizer_states else None
+            any_moment = False
             sd = {"m": {}, "v": {}, "step": int(passthrough.get("opt_state.step", 0))}
             for key, shape in zip(self._offload_keys, self._offload_shapes):
                 a = atoms.get(key)
                 if a is None:
                     logger.warning(f"universal load: no atom for param {key}; keeping current")
-                    continue
-                self._offload_state.params[key][...] = fit(a[PARAM_ATOM], template(shape), key).ravel()
+                    a = {}
+                else:
+                    self._offload_state.params[key][...] = fit(a[PARAM_ATOM], template(shape),
+                                                               key).ravel()
                 if load_optimizer_states:
-                    if "exp_avg" in a:
-                        sd["m"][key] = fit(a["exp_avg"], template(shape), key).ravel()
-                    if "exp_avg_sq" in a:
-                        sd["v"][key] = fit(a["exp_avg_sq"], template(shape), key).ravel()
+                    for atom_name, slot in (("exp_avg", "m"), ("exp_avg_sq", "v")):
+                        if atom_name in a:
+                            sd[slot][key] = fit(a[atom_name], template(shape), key).ravel()
+                            any_moment = True
+                        else:
+                            sd[slot][key] = cur[slot][key]
                     extra = sorted(set(a) - {PARAM_ATOM, "exp_avg", "exp_avg_sq"})
-                    if extra or "exp_avg" not in a:
+                    if a and (extra or "exp_avg" not in a):
                         logger.warning(
                             f"universal load (offload): param {key} has atoms {sorted(a)} "
                             f"but the host-offload Adam consumes exp_avg/exp_avg_sq only — "
-                            f"unmatched moments keep their current (zero) values")
-            if load_optimizer_states and sd["m"]:
+                            f"unmatched moments keep their current values")
+            if load_optimizer_states and any_moment:
                 self._offload_state.load_state_dict(sd)
             self._push_compute_params()
         else:

@@ -41,6 +41,24 @@ def _tree_zeros_like(params, dtype=None):
     return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=dtype or p.dtype), params)
 
 
+class _Packed:
+    """Opaque multi-value leaf for tree_map fan-out.  Deliberately NOT a
+    pytree: a structural tuple/NamedTuple inside the params tree can never be
+    confused with it, unlike the old ``is_leaf=isinstance(t, tuple)`` pattern
+    that silently mis-split tuple-structured models (ADVICE r3 #3)."""
+    __slots__ = ("vals", )
+
+    def __init__(self, *vals):
+        self.vals = vals
+
+
+def _split(packed_tree, n: int):
+    """Fan a tree of _Packed leaves out into ``n`` parallel trees."""
+    return tuple(jax.tree_util.tree_map(lambda t: t.vals[i], packed_tree,
+                                        is_leaf=lambda t: isinstance(t, _Packed))
+                 for i in range(n))
+
+
 class AdamState(NamedTuple):
     step: jnp.ndarray
     exp_avg: Any  # m
@@ -75,12 +93,10 @@ def adam(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adam_w_mode=True, bias_
             upd = -lr * (m_new / bc1) / denom
             if adam_w_mode and weight_decay != 0.0:
                 upd = upd - lr * weight_decay * p
-            return upd, m_new, v_new
+            return _Packed(upd, m_new, v_new)
 
         flat = jax.tree_util.tree_map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
-        v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+        updates, m, v = _split(flat, 3)
         return updates, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
 
     return Optimizer(init=init, update=update, name="adamw" if adam_w_mode else "adam")
@@ -105,13 +121,10 @@ def fused_adam(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adam_w_mode=True,
             p2, m2, v2 = fused_adamw_flat(p.ravel(), m.ravel(), v.ravel(), g.ravel(),
                                           lr=lr, beta1=b1, beta2=b2, eps=eps,
                                           weight_decay=weight_decay, step=step)
-            return p2.reshape(p.shape), m2.reshape(m.shape), v2.reshape(v.shape)
+            return _Packed(p2.reshape(p.shape), m2.reshape(m.shape), v2.reshape(v.shape))
 
         flat = jax.tree_util.tree_map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
-        istup = lambda t: isinstance(t, tuple)
-        new_params = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=istup)
-        m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=istup)
-        v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=istup)
+        new_params, m, v = _split(flat, 3)
         return new_params, AdamState(step=step, exp_avg=m, exp_avg_sq=v)
 
     # the kernel hard-codes decoupled decay + bias correction; other modes run
@@ -144,12 +157,10 @@ def fused_adam8bit(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
         def leaf(p):
             q, s = init_quantized_moment(int(np.prod(p.shape)) if p.shape else 1,
                                          group_size)
-            return q, s
+            return _Packed(q, s)
 
         pairs = jax.tree_util.tree_map(leaf, params)
-        istup = lambda t: isinstance(t, tuple)
-        q = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=istup)
-        s = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=istup)
+        q, s = _split(pairs, 2)
         return Adam8bitState(step=jnp.zeros((), jnp.int32),
                              exp_avg=q, exp_avg_sq=jax.tree_util.tree_map(jnp.copy, q),
                              scale_m=s, scale_v=jax.tree_util.tree_map(jnp.copy, s))
@@ -162,16 +173,15 @@ def fused_adam8bit(betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                 p.ravel(), m8, v8, sm, sv, g.ravel(), lr=lr, beta1=b1, beta2=b2,
                 eps=eps, weight_decay=weight_decay, step=step,
                 group_size=group_size, use_kernel=use_kernel)
-            return p2.reshape(p.shape), m2, v2, sm2, sv2
+            return _Packed(p2.reshape(p.shape), m2, v2, sm2, sv2)
 
         flat = jax.tree_util.tree_map(
             leaf, grads, state.exp_avg, state.exp_avg_sq,
             state.scale_m, state.scale_v, params)
-        istup = lambda t: isinstance(t, tuple)
-        pick = lambda i: jax.tree_util.tree_map(lambda t: t[i], flat, is_leaf=istup)
-        new_state = Adam8bitState(step=step, exp_avg=pick(1), exp_avg_sq=pick(2),
-                                  scale_m=pick(3), scale_v=pick(4))
-        return pick(0), new_state
+        new_params, m, v, sm, sv = _split(flat, 5)
+        new_state = Adam8bitState(step=step, exp_avg=m, exp_avg_sq=v,
+                                  scale_m=sm, scale_v=sv)
+        return new_params, new_state
 
     def update(grads, state, params, lr):
         # delta form, plain-XLA math: runs under GSPMD on any mesh (a
@@ -200,11 +210,10 @@ def sgd(momentum=0.0, weight_decay=0.0, nesterov=False) -> Optimizer:
                 g = g + weight_decay * p
             buf_new = momentum * buf + g
             d = (g + momentum * buf_new) if nesterov else (buf_new if momentum != 0.0 else g)
-            return -lr * d, buf_new
+            return _Packed(-lr * d, buf_new)
 
         flat = jax.tree_util.tree_map(leaf, grads, state.momentum_buf, params)
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        buf = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        updates, buf = _split(flat, 2)
         return updates, SGDState(momentum_buf=buf)
 
     return Optimizer(init=init, update=update, name="sgd")
@@ -229,11 +238,10 @@ def lion(betas=(0.9, 0.99), weight_decay=0.0) -> Optimizer:
             if weight_decay != 0.0:
                 upd = upd - lr * weight_decay * p
             m_new = b2 * m + (1.0 - b2) * g
-            return upd, m_new
+            return _Packed(upd, m_new)
 
         flat = jax.tree_util.tree_map(leaf, grads, state.exp_avg, params)
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        updates, m = _split(flat, 2)
         return updates, LionState(exp_avg=m)
 
     return Optimizer(init=init, update=update, name="lion")
@@ -255,11 +263,10 @@ def adagrad(eps=1e-10, weight_decay=0.0) -> Optimizer:
             if weight_decay != 0.0:
                 g = g + weight_decay * p
             acc_new = acc + g * g
-            return -lr * g / (jnp.sqrt(acc_new) + eps), acc_new
+            return _Packed(-lr * g / (jnp.sqrt(acc_new) + eps), acc_new)
 
         flat = jax.tree_util.tree_map(leaf, grads, state.accum, params)
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        acc = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        updates, acc = _split(flat, 2)
         return updates, AdagradState(accum=acc)
 
     return Optimizer(init=init, update=update, name="adagrad")
@@ -293,12 +300,10 @@ def lamb(betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0, max_coeff=10.0, min_coe
             p_norm = jnp.linalg.norm(p.astype(jnp.float32).ravel())
             u_norm = jnp.linalg.norm(u.astype(jnp.float32).ravel())
             trust = jnp.where((p_norm > 0) & (u_norm > 0), jnp.clip(p_norm / u_norm, min_coeff, max_coeff), 1.0)
-            return -lr * trust * u, m_new, v_new
+            return _Packed(-lr * trust * u, m_new, v_new)
 
         flat = jax.tree_util.tree_map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
-        updates = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
-        v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=lambda t: isinstance(t, tuple))
+        updates, m, v = _split(flat, 3)
         return updates, LambState(step=step, exp_avg=m, exp_avg_sq=v)
 
     return Optimizer(init=init, update=update, name="lamb")

@@ -41,7 +41,12 @@ class AsyncPartitionedParameterSwapper:
     """
 
     def __init__(self, nvme_path: str, buffer_count: int = 4, aio_threads: int = 4,
-                 use_odirect: bool = False):
+                 use_odirect: bool = True):
+        # O_DIRECT by default, like the reference's libaio queues
+        # (deepspeed_aio_common.cpp): page-cache writeback throttling caps
+        # buffered writes at ~100 MB/s on typical cloud VMs while direct IO
+        # sustains the device's ~800 MB/s; tmpfs and other O_DIRECT-refusing
+        # filesystems fall back per-file inside the library.
         self.dir = os.path.join(nvme_path, "dstpu_param_swap")
         os.makedirs(self.dir, exist_ok=True)
         self.aio = build_aio_handle(aio_threads, use_odirect=use_odirect)
@@ -148,14 +153,19 @@ class SwappedLayerTrainer:
                  lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, compute_dtype=jnp.bfloat16,
                  stem_fn: Optional[Callable] = None,
-                 optimizer_device: str = "nvme"):
+                 optimizer_device: str = "nvme",
+                 offload_activations: bool = False):
         """``stem_fn(stem_params, x) -> hidden`` is the optional trainable input
         transform (token embedding) ahead of the layer stack; its params stay
-        host-resident like the head's (the reference keeps embeddings persistent
-        via param_persistence_threshold).  ``optimizer_device``: "nvme" streams
-        Adam moments per layer alongside the params; "cpu" pins them in host RAM
-        (the reference's offload_optimizer: cpu + offload_param: nvme combo —
-        ZeRO-Infinity with moments one tier up, halving per-step disk traffic)."""
+        DEVICE-resident like the head's, with a jitted AdamW (the reference
+        keeps embeddings persistent via param_persistence_threshold).
+        ``optimizer_device``: "nvme" streams Adam moments per layer alongside
+        the params; "cpu" pins them in host RAM (the reference's
+        offload_optimizer: cpu + offload_param: nvme combo — ZeRO-Infinity with
+        moments one tier up, halving per-step disk traffic).
+        ``offload_activations``: keep layer-input checkpoints on host instead of
+        HBM (the reference's cpu_checkpointing; costs 2x activations over the
+        host link per step — leave off unless HBM is the binding constraint)."""
         assert optimizer_device in ("nvme", "cpu")
         self.layer_fn = layer_fn
         self.num_layers = num_layers
@@ -163,9 +173,12 @@ class SwappedLayerTrainer:
         self.stem_fn = stem_fn
         self.swapper = swapper
         self.compute_dtype = compute_dtype
+        self._np_compute = np.dtype(compute_dtype)  # ml_dtypes-backed (bf16 ok)
         self.optimizer_device = optimizer_device
+        self.offload_activations = offload_activations
         from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
         self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay)
+        self._default_lr = lr
         self.step_count = 0
         self._layer_treedef = None
         self._cpu_m: Optional[List[List[np.ndarray]]] = None  # [layer][leaf]
@@ -173,13 +186,48 @@ class SwappedLayerTrainer:
         self._fwd_jit = jax.jit(lambda p, x: self.layer_fn(p, x))
         # backward recompute, compiled: (params, x, cotangent) -> (dparams, dx)
         self._bwd_jit = jax.jit(lambda p, x, ct: jax.vjp(self.layer_fn, p, x)[1](ct))
-        # head loss+grads, compiled (labels as a traced argument)
+
+        def cast16(tree):
+            return jax.tree_util.tree_map(lambda a: a.astype(compute_dtype), tree)
+
+        # head loss+grads, compiled: the fp32 master head lives ON DEVICE and
+        # casts to compute dtype INSIDE the jit (mixed-precision grads come
+        # back fp32), so the 2 x vocab x hidden head tensors never cross the
+        # host<->device link per step — that link is PCIe on real hardware but
+        # a ~20 MB/s network relay under the axon tunnel
         self._head_jit = jax.jit(
-            lambda h, x, y: jax.value_and_grad(
-                lambda hh, xx: self.head_fn(hh, xx, y), argnums=(0, 1))(h, x))
+            lambda h32, x, y: jax.value_and_grad(
+                lambda hh, xx: self.head_fn(cast16(hh), xx, y), argnums=(0, 1))(h32, x))
         if stem_fn is not None:
-            self._stem_jit = jax.jit(lambda sp, x: stem_fn(sp, x))
-            self._stem_bwd_jit = jax.jit(lambda sp, x, ct: jax.vjp(stem_fn, sp, x)[1](ct)[0])
+            self._stem_jit = jax.jit(lambda sp32, x: stem_fn(cast16(sp32), x))
+            self._stem_bwd_jit = jax.jit(
+                lambda sp32, x, ct: jax.vjp(lambda sp: stem_fn(cast16(sp), x), sp32)[1](ct)[0])
+
+        # device-resident AdamW for the persistent (head/stem) groups — same
+        # decoupled-decay math as the host cpu_adam stepping the streamed layers
+        b1, b2 = betas
+
+        def persist_step(params, m, v, grads, lr_t, step_t):
+            flat_p, tdef = jax.tree_util.tree_flatten(params)
+            flat_m = jax.tree_util.tree_leaves(m)
+            flat_v = jax.tree_util.tree_leaves(v)
+            flat_g = jax.tree_util.tree_leaves(grads)
+            new_p, new_m, new_v = [], [], []
+            for p, mm, vv, g in zip(flat_p, flat_m, flat_v, flat_g):
+                g = g.astype(jnp.float32)
+                mm = b1 * mm + (1 - b1) * g
+                vv = b2 * vv + (1 - b2) * g * g
+                mhat = mm / (1 - jnp.power(b1, step_t))
+                vhat = vv / (1 - jnp.power(b2, step_t))
+                new_p.append(p - lr_t * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p))
+                new_m.append(mm)
+                new_v.append(vv)
+            unf = lambda leaves: jax.tree_util.tree_unflatten(tdef, leaves)
+            return unf(new_p), unf(new_m), unf(new_v)
+
+        self._persist_opt = jax.jit(persist_step, donate_argnums=(0, 1, 2))
+        self._head_m = self._head_v = None
+        self._stem_m = self._stem_v = None
 
     # ---------------------------------------------------------- initialize
     def init_from_stacked(self, stacked_params: Any, head_params: Any,
@@ -206,9 +254,10 @@ class SwappedLayerTrainer:
             # layer's source arrays (they're host views into the stacked tree)
             for r in rids:
                 self.swapper.aio.wait(r)
-        self.head = jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), head_params)
+        # persistent groups: fp32 master ON DEVICE (uploaded once, not per step)
+        self.head = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32), head_params)
         self.stem = (None if stem_params is None else
-                     jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32), stem_params))
+                     jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32), stem_params))
         n = sum(int(np.prod(np.shape(x))) for x in leaves)
         log_dist(f"param nvme swap: {self.num_layers} layers, {n/1e6:.2f}M stacked elems "
                  f"on {self.swapper.dir} (moments: {self.optimizer_device})", ranks=[0])
@@ -223,19 +272,30 @@ class SwappedLayerTrainer:
         return f"layer{l}.v"
 
     def _device_params(self, host_leaves):
+        """Upload one layer in COMPUTE dtype: the fp32->bf16 cast runs on host
+        so half the bytes cross the host->device link (PCIe on real hardware;
+        a ~20 MB/s network relay under the axon tunnel, where this halves the
+        per-layer stream time)."""
         tree = jax.tree_util.tree_unflatten(self._layer_treedef, host_leaves)
-        return jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), tree)
+        # astype always copies (even same-dtype): the source is a POOLED buffer
+        # that recycles as soon as we release it — an uploaded view would race
+        # the async transfer
+        return jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a).astype(self._np_compute)), tree)
+
+    def _zeros_like_tree(self, tree):
+        return jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), tree)
 
     # ---------------------------------------------------------- train step
     def train_step(self, batch: Dict[str, np.ndarray], lr: Optional[float] = None):
         """One full fwd+bwd+update with layer streaming.  Returns the loss."""
+        lr_f = float(lr) if lr is not None else self._default_lr
         if self.stem_fn is not None:
-            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
             x_tokens = jnp.asarray(batch["x"])
-            x = self._stem_jit(stem_dev, x_tokens)
+            x = self._stem_jit(self.stem, x_tokens)
         else:
             x = jnp.asarray(batch["x"], self.compute_dtype)
-        saved_inputs: List[np.ndarray] = [None] * self.num_layers
+        saved_inputs: List = [None] * self.num_layers
 
         # ---- forward: stream 0..L-1, double-buffered prefetch
         self.swapper.swap_in_async(self._pkey(0))
@@ -246,23 +306,22 @@ class SwappedLayerTrainer:
             host = self.swapper.wait_in(self._pkey(l))
             if l + 1 < self.num_layers:
                 self.swapper.swap_in_async(self._pkey(l + 1))
-            saved_inputs[l] = np.asarray(x)  # activation checkpoint on host
+            # activation checkpoint: HBM by default (L x micro x seq x hidden
+            # bf16 — ~0.5 GB at 7B/seq2048/micro1); host when requested
+            saved_inputs[l] = np.asarray(x) if self.offload_activations else x
             x = self._fwd_jit(self._device_params(host), x)
             self.swapper.release(self._pkey(l))
 
-        # ---- head loss + gradient of head params and last activation
-        head_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.head)
-        (loss, dhead, dx) = self._head_grads(head_dev, x, batch)
+        # ---- head loss + grads; head master/moments stay on device
+        (loss, dhead, dx) = self._head_grads(self.head, x, batch)
         self.step_count += 1
         step = self.step_count
-        flat_head, head_def = jax.tree_util.tree_flatten(self.head)
-        flat_dhead = jax.tree_util.tree_leaves(dhead)
-        if not hasattr(self, "_head_m"):
-            self._head_m = [np.zeros_like(a) for a in flat_head]
-            self._head_v = [np.zeros_like(a) for a in flat_head]
-        for p, m, v, g in zip(flat_head, self._head_m, self._head_v, flat_dhead):
-            self.opt.step(p.ravel(), m.ravel(), v.ravel(),
-                          np.asarray(g, np.float32).ravel(), lr=lr, step=step)
+        if self._head_m is None:
+            self._head_m = self._zeros_like_tree(self.head)
+            self._head_v = self._zeros_like_tree(self.head)
+        self.head, self._head_m, self._head_v = self._persist_opt(
+            self.head, self._head_m, self._head_v, dhead,
+            jnp.float32(lr_f), jnp.int32(step))
 
         # ---- backward: stream L-1..0, recompute layer fwd, step immediately
         for l in reversed(range(self.num_layers)):
@@ -285,7 +344,7 @@ class SwappedLayerTrainer:
                 v_host = self.swapper.wait_in(self._vkey(l))
             grads = [np.asarray(g, np.float32) for g in jax.tree_util.tree_leaves(dparams)]
             for p, m, v, g in zip(host, m_host, v_host, grads):
-                self.opt.step(p.ravel(), m.ravel(), v.ravel(), g.ravel(), lr=lr, step=step)
+                self.opt.step(p.ravel(), m.ravel(), v.ravel(), g.ravel(), lr=lr_f, step=step)
             # join THIS layer's writes (by rid — wait_all would orphan the
             # in-flight prefetch of layer l-1) before its buffers recycle: a
             # pooled buffer must not be overwritten mid-write, and the next
@@ -303,27 +362,23 @@ class SwappedLayerTrainer:
 
         # ---- stem (embedding) grads from the dx that reached layer 0's input
         if self.stem_fn is not None:
-            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
-            dstem = self._stem_bwd_jit(stem_dev, x_tokens, dx.astype(self.compute_dtype))
-            flat_stem = jax.tree_util.tree_leaves(self.stem)
-            flat_dstem = jax.tree_util.tree_leaves(dstem)
-            if not hasattr(self, "_stem_m"):
-                self._stem_m = [np.zeros_like(a) for a in flat_stem]
-                self._stem_v = [np.zeros_like(a) for a in flat_stem]
-            for p, m, v, g in zip(flat_stem, self._stem_m, self._stem_v, flat_dstem):
-                self.opt.step(p.ravel(), m.ravel(), v.ravel(),
-                              np.asarray(g, np.float32).ravel(), lr=lr, step=step)
+            dstem = self._stem_bwd_jit(self.stem, x_tokens, dx.astype(self.compute_dtype))
+            if self._stem_m is None:
+                self._stem_m = self._zeros_like_tree(self.stem)
+                self._stem_v = self._zeros_like_tree(self.stem)
+            self.stem, self._stem_m, self._stem_v = self._persist_opt(
+                self.stem, self._stem_m, self._stem_v, dstem,
+                jnp.float32(lr_f), jnp.int32(step))
         return float(loss)
 
-    def _head_grads(self, head_dev, x, batch):
-        loss, grads = self._head_jit(head_dev, x, jnp.asarray(batch["y"]))
+    def _head_grads(self, head32, x, batch):
+        loss, grads = self._head_jit(head32, x, jnp.asarray(batch["y"]))
         return loss, grads[0], grads[1]
 
     # ---------------------------------------------------------- inference
     def forward(self, x: np.ndarray):
         if self.stem_fn is not None:
-            stem_dev = jax.tree_util.tree_map(lambda a: jnp.asarray(a, self.compute_dtype), self.stem)
-            x = self._stem_jit(stem_dev, jnp.asarray(x))
+            x = self._stem_jit(self.stem, jnp.asarray(x))
         else:
             x = jnp.asarray(x, self.compute_dtype)
         self.swapper.swap_in_async(self._pkey(0))

@@ -3,14 +3,26 @@
 Beyond-reference long-context capability (the reference snapshot has NO ring/
 blockwise CP — SURVEY §5.7; long context = Ulysses only): q/k/v stay sequence-
 sharded [B, s/P, H, D]; K/V blocks rotate around the ring (``lax.ppermute`` →
-ICI neighbor exchange) while each rank accumulates blockwise online-softmax
-attention of its local queries — memory O(s/P) per chip, comm O(s/P) per link
-per step, fully overlapped by XLA with the block matmuls.
+ICI neighbor exchange) while each rank merges per-block attention via saved
+logsumexp — memory O(s/P) per chip, comm O(s/P) per link per step, overlapped
+by XLA with the block matmuls.
+
+v2 (round 4, VERDICT r3 #5):
+- GQA runs grouped (q reshaped [B, s, hk, g, d]) — no ``jnp.repeat`` KV
+  materialization.
+- The inner block attention is a chunked online-softmax scan with
+  flash-equivalent O(s·chunk) live memory, differentiable end-to-end (see
+  _block_attention for why a raw pallas_call fwd can't be the default here).
+- Causal rings skip fully-masked steps: at step j only ranks my >= j compute
+  (``lax.cond`` on the block source), so aggregate FLOPs drop ~2x; the
+  ppermute still runs every step (it's the collective schedule).
+- Per-block (out, lse) pairs merge in the numerically-stable weighted form,
+  so the inner attention can be ANY kernel that returns logsumexp.
 
 Comm volume matches Ulysses per link but removes the all-to-all's full-mesh
 traffic pattern (pure neighbor exchange — ideal for TPU ICI rings), and scales
 to sequence lengths where even one rank's full-sequence heads (Ulysses) no
-longer fit.  Composes with GQA (kv heads broadcast locally).
+longer fit: Ulysses activations scale O(S·H/P·D) per chip, ring O(S/P·H·D).
 """
 
 import functools
@@ -27,6 +39,60 @@ from ..parallel.mesh import SEQUENCE_AXIS, MeshTopology, get_topology
 NEG_INF = -1e30
 
 
+def _block_attention(q, k, v, causal: bool, scale: float, chunk: int = 1024):
+    """One block-pair attention returning (out [B,s,hq,d] fp32 — normalized
+    within the block, lse [B,s,hq,1] fp32).
+
+    Flash-equivalent memory in pure XLA: an online-softmax ``lax.scan`` over
+    K-chunks keeps live scores at O(s·chunk) instead of O(s²) — so the ring's
+    per-chip activation memory really is O(s/P·chunk), and the whole ring
+    stays differentiable (a raw pallas_call fwd would not be; the chunk body
+    is ``jax.checkpoint``ed so the backward recomputes per chunk rather than
+    saving every chunk's probabilities).  GQA stays grouped (q reshaped to
+    [B,s,hk,g,d]) — no repeated-KV materialization.  A fused Pallas ring
+    kernel (block compute + ppermute in one kernel) is the remaining perf
+    lever; this form already MXU-tiles via the chunk matmuls."""
+    b, s, hq, d = q.shape
+    hk = k.shape[2]
+    g = hq // hk
+    C = min(chunk, s)
+    n_chunks = -(-s // C)
+    pad = n_chunks * C - s
+    qf = q.astype(jnp.float32).reshape(b, s, hk, g, d)
+    kf = jnp.pad(k.astype(jnp.float32), ((0, 0), (0, pad), (0, 0), (0, 0)))
+    vf = jnp.pad(v.astype(jnp.float32), ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kc = kf.reshape(b, n_chunks, C, hk, d).transpose(1, 0, 2, 3, 4)  # [n, b, C, hk, d]
+    vc = vf.reshape(b, n_chunks, C, hk, d).transpose(1, 0, 2, 3, 4)
+    qpos = jnp.arange(s)
+
+    def body(carry, inp):
+        acc, l, m = carry
+        k_blk, v_blk, c_idx = inp
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk) * scale  # [b,hk,g,s,C]
+        kpos = c_idx * C + jnp.arange(C)
+        live = kpos[None, :] < s  # pad keys masked
+        if causal:  # same-block diagonal: local positions align
+            live = jnp.logical_and(live, kpos[None, :] <= qpos[:, None])
+        scores = jnp.where(live[None, None, None], scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhgqk,bkhd->bhgqd", p, v_blk)
+        return (acc, l, m_new), None
+
+    acc0 = jnp.zeros((b, hk, g, s, d), jnp.float32)
+    l0 = jnp.zeros((b, hk, g, s, 1), jnp.float32)
+    m0 = jnp.full((b, hk, g, s, 1), NEG_INF, jnp.float32)
+    (acc, l, m), _ = lax.scan(jax.checkpoint(body), (acc0, l0, m0),
+                              (kc, vc, jnp.arange(n_chunks)))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o = (acc / l_safe).transpose(0, 3, 1, 2, 4).reshape(b, s, hq, d)
+    lse = (m + jnp.log(l_safe)).transpose(0, 3, 1, 2, 4).reshape(b, s, hq, 1)
+    return o, lse
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                           softmax_scale: Optional[float] = None):
     """Runs INSIDE shard_map. q/k/v: local [B, s, H, D] shards (kv heads may be
@@ -34,44 +100,46 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     P = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s, hq, d = q.shape
-    hk = k.shape[2]
-    if hk != hq:
-        rep = hq // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
 
-    qf = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B, H, s, D]
-    acc = jnp.zeros((b, hq, s, d), jnp.float32)
-    m = jnp.full((b, hq, s, 1), NEG_INF, jnp.float32)
-    l = jnp.zeros((b, hq, s, 1), jnp.float32)
+    acc = jnp.zeros((b, s, hq, d), jnp.float32)
+    den = jnp.zeros((b, s, hq, 1), jnp.float32)
+    m_run = jnp.full((b, s, hq, 1), NEG_INF, jnp.float32)
 
     perm = [(r, (r + 1) % P) for r in range(P)]
     k_cur, v_cur = k, v
-    qpos = my * s + jnp.arange(s)  # global query positions
 
     for step in range(P):
         src = (my - step) % P  # which global block k_cur holds
-        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-        if causal:
-            kpos = src * s + jnp.arange(s)
-            mask = kpos[None, :] <= qpos[:, None]  # [s, s]
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
-        blk_max = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, blk_max)
-        p = jnp.exp(scores - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
-        m = m_new
+
+        def merge(carry, k_blk, v_blk, blk_causal):
+            acc, den, m_run = carry
+            o, lse = _block_attention(q, k_blk, v_blk, blk_causal, scale)
+            m_new = jnp.maximum(m_run, lse)
+            w_old = jnp.exp(m_run - m_new)
+            w_blk = jnp.exp(lse - m_new)
+            return (acc * w_old + o * w_blk, den * w_old + w_blk, m_new)
+
+        if not causal:
+            acc, den, m_run = merge((acc, den, m_run), k_cur, v_cur, False)
+        elif step == 0:
+            # diagonal block: always live, causally masked within the block
+            acc, den, m_run = merge((acc, den, m_run), k_cur, v_cur, True)
+        else:
+            # block src is fully BELOW the causal line iff src < my (compute
+            # unmasked); fully above iff src > my (skip — this is the ~2x
+            # aggregate FLOPs saving for causal rings).  src == my only at
+            # step 0.  lax.cond keeps the skip a runtime branch per rank.
+            acc, den, m_run = lax.cond(
+                src < my,
+                lambda c, kb, vb: merge(c, kb, vb, False),
+                lambda c, kb, vb: c,
+                (acc, den, m_run), k_cur, v_cur)
         if step < P - 1:
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
 
-    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (causal prefix)
-    out = (acc / l_safe).transpose(0, 2, 1, 3)
+    out = acc / jnp.where(den == 0.0, 1.0, den)
     return out.astype(q.dtype)
 
 

@@ -79,12 +79,31 @@ def safe_set_full_fp32_param(engine, param_path: str, value) -> None:
 
 
 def safe_get_full_optimizer_state(engine, param_path: str, state_name: str) -> Optional[np.ndarray]:
-    """Gather one optimizer moment ('exp_avg'/'exp_avg_sq') (reference :134)."""
+    """Gather one optimizer moment ('exp_avg'/'exp_avg_sq') (reference :134).
+
+    Quantized optimizers return the DEQUANTIZED fp32 moment in the param's
+    shape — the reference API contract is a torch-tensor-shaped moment, not
+    the raw storage (ADVICE r3 #1): fused_adam8bit's int8 (groups, group_size)
+    blocks decode through ops/adam/adam8bit.dequantize_moments (v is stored in
+    the sqrt domain and squared back here)."""
     if engine.offload_device is not None:
         sd = engine._offload_state.state_dict()
         key = {"exp_avg": "m", "exp_avg_sq": "v"}[state_name]
         return sd[key][param_path].copy()
-    moments = _resolve(engine.state.opt_state, state_name)
+    opt_state = engine.state.opt_state
+    if type(opt_state).__name__ == "Adam8bitState" and state_name in ("exp_avg", "exp_avg_sq"):
+        from ..ops.adam.adam8bit import dequantize_moments
+        param = _resolve(engine.state.params, param_path)
+        n = int(np.prod(np.shape(param))) if np.shape(param) else 1
+        m8 = _gather_full(_resolve(opt_state.exp_avg, param_path))
+        v8 = _gather_full(_resolve(opt_state.exp_avg_sq, param_path))
+        sm = _gather_full(_resolve(opt_state.scale_m, param_path))
+        sv = _gather_full(_resolve(opt_state.scale_v, param_path))
+        m, v = dequantize_moments(jax.numpy.asarray(m8), jax.numpy.asarray(v8),
+                                  jax.numpy.asarray(sm), jax.numpy.asarray(sv), n)
+        out = m if state_name == "exp_avg" else v
+        return np.asarray(out).reshape(np.shape(param))
+    moments = _resolve(opt_state, state_name)
     return _gather_full(_resolve(moments, param_path))
 
 

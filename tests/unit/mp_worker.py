@@ -74,6 +74,65 @@ def main() -> None:
     assert np.isfinite(post)
     lines.append(f"ckpt_roundtrip_tag={tag} post_loss={post:.6f}")
 
+    # --- TP v2 serving across BOTH controllers (VERDICT r3 #8) ------------
+    # tensor axis = all 8 devices spanning the 2 processes: params + KV pool
+    # shard across non-addressable devices, the paged shard_map psums ride the
+    # cross-process fabric, and greedy decode must equal a single-device
+    # reference computed locally.
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.parallel import reset_topology
+
+    reset_topology()
+    tp_topo = MeshTopology.from_axis_dict({"tensor": 8})
+    icfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=8, kv_heads=8, seq=64)
+    iparams = llama.init_params(icfg, jax.random.PRNGKey(3))
+    eng2 = InferenceEngineV2(llama, icfg, iparams, config={"dtype": "float32"},
+                             topology=tp_topo, num_blocks=32, block_size=8,
+                             max_blocks_per_seq=8, token_budget=16, max_seqs_per_step=2)
+    prompt = [1, 2, 3, 4, 5]
+    got = eng2.generate([prompt], max_new_tokens=4)[0]
+    # local reference: greedy full-forward decode on this process's devices
+    ref_ids = list(prompt)
+    for _ in range(4):
+        logits = llama.forward(icfg, iparams, jnp.asarray([ref_ids]))
+        ref_ids.append(int(jnp.argmax(logits[0, -1])))
+    assert got == ref_ids, (got, ref_ids)
+    lines.append(f"tp8_v2_decode={','.join(map(str, got[len(prompt):]))}")
+
+    # --- 2-stage compiled pipeline across the process boundary ------------
+    from jax.sharding import NamedSharding, PartitionSpec
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule, restack_for_pipeline
+
+    reset_topology()
+    pipe_topo = MeshTopology.from_axis_dict({"pipe": 2, "data": 4})
+
+    def layer_fn(lp, x):
+        return jnp.tanh(x @ lp["w"] + lp["b"])
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    layers4 = {"w": jnp.stack([jax.random.normal(k, (16, 16)) * 0.5 for k in ks]),
+               "b": jnp.zeros((4, 16))}
+    stacked = restack_for_pipeline(layers4, 2)
+    pipe = PipelineModule(layer_fn, num_stages=2, topo=pipe_topo)
+
+    def rep(x):  # replicated global array from identical host values
+        host = np.asarray(x)
+        sh = NamedSharding(pipe_topo.mesh, PartitionSpec())
+        return jax.make_array_from_callback(host.shape, sh, lambda idx, a=host: a[idx])
+
+    xs = np.random.default_rng(1).normal(size=(4, 4, 16)).astype(np.float32)
+    out = jax.jit(lambda p, v: pipe(p, v))(jax.tree_util.tree_map(rep, stacked), rep(xs))
+    # reference: plain scan through the 4 layers, microbatch-wise
+    def ref_fwd(v):
+        h = v
+        for i in range(4):
+            h = np.tanh(h @ np.asarray(layers4["w"][i]) + np.asarray(layers4["b"][i]))
+        return h
+    expected = np.stack([ref_fwd(xs[m]) for m in range(xs.shape[0])])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-6)
+    lines.append("pipe2_cross_process=ok")
+
     with open(os.path.join(tmp, f"ok.rank{rank}"), "w") as fh:
         fh.write("\n".join(lines) + "\n")
 

@@ -64,6 +64,10 @@ def test_two_process_zero3_collectives_and_checkpoint(tmp_path):
         results.append(marker.read_text())
     assert results[0] == results[1], (results[0], results[1])
     assert "zero3_losses=" in results[0] and "ckpt_roundtrip_tag=" in results[0]
+    # round-4 lane extensions (VERDICT r3 #8): cross-process TP serving +
+    # compiled pipeline, the two comm patterns furthest from plain dp
+    assert "tp8_v2_decode=" in results[0]
+    assert "pipe2_cross_process=ok" in results[0]
 
 
 def test_launcher_local_spawn(tmp_path):

@@ -185,3 +185,76 @@ def test_engine_fused_adam_trains(mesh8):
     for _ in range(5):
         m = engine.train_batch(batch)
     assert float(m.loss) < first
+
+
+@pytest.mark.slow
+def test_adam8bit_long_horizon_tracks_fp32_adamw():
+    """ADVICE r3 #5: the blockwise-int8 moments' requant error (notably m's
+    linear code flushing |m| < absmax/254 per group) must not derail
+    convergence over a few hundred steps — the 12-step bench leg alone can't
+    see slow drift.  A 2-layer MLP regression trains 300 steps under both
+    optimizers; 8-bit must reach within 1.5x of fp32 AdamW's final loss."""
+    import jax
+    import jax.numpy as jnp
+
+    def make(opt_name):
+        opt = optimizers.get_optimizer(opt_name)
+        key = jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(key)
+        params = {"w1": jax.random.normal(k1, (32, 64)) * 0.2,
+                  "w2": jax.random.normal(k2, (64, 8)) * 0.2}
+        state = opt.init(params)
+        return opt, params, state
+
+    rng = np.random.default_rng(0)
+    w_true1 = rng.normal(size=(32, 64)).astype(np.float32) * 0.3
+    w_true2 = rng.normal(size=(64, 8)).astype(np.float32) * 0.3
+    x_all = rng.normal(size=(2048, 32)).astype(np.float32)
+    y_all = np.tanh(x_all @ w_true1) @ w_true2
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    def train(opt_name, steps=300, bs=64, lr=3e-3):
+        opt, params, state = make(opt_name)
+
+        @jax.jit
+        def step(params, state, x, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, state = opt.update(grads, state, params, jnp.float32(lr))
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, state, loss
+
+        for i in range(steps):
+            lo = (i * bs) % (2048 - bs)
+            params, state, loss = step(params, state, x_all[lo:lo + bs], y_all[lo:lo + bs])
+        return float(loss_fn(params, x_all, y_all))
+
+    fp32_final = train("adamw")
+    q8_final = train("fused_adam8bit")
+    assert np.isfinite(q8_final)
+    assert q8_final < 1.5 * fp32_final + 1e-5, (q8_final, fp32_final)
+
+
+def test_tensor_fragment_dequantizes_adam8bit_state():
+    """ADVICE r3 #1: safe_get_full_optimizer_state must return the fp32
+    param-shaped moment for fused_adam8bit, not the raw int8 blocks."""
+    import deepspeed_tpu
+    from deepspeed_tpu.utils.tensor_fragment import safe_get_full_optimizer_state
+    from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=16)
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "fused_adam8bit", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}})
+    eng.train_batch(random_batch(eng.train_batch_size, hidden=16, seed=0))
+    m = safe_get_full_optimizer_state(eng, "layer_0.w", "exp_avg")
+    v = safe_get_full_optimizer_state(eng, "layer_0.w", "exp_avg_sq")
+    w = np.asarray(jax.tree_util.tree_leaves(eng.state.params)[0])
+    assert m.shape == (16, 16) and v.shape == (16, 16)
+    assert m.dtype == np.float32 and v.dtype == np.float32
+    assert np.all(v >= 0)  # second moment (squared back from sqrt domain)
+    assert np.abs(m).max() > 0  # a step actually populated it
