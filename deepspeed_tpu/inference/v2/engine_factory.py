@@ -1,10 +1,10 @@
 """v2 engine factory — build a ragged serving engine from a HF checkpoint.
 
 Reference ``build_hf_engine`` (inference/v2/engine_factory.py:66): resolves the
-model's policy by HF ``model_type`` and assembles InferenceEngineV2.  Supported
-here: llama, mistral (sliding window), mixtral (MoE) — the reference's other
-families (opt/falcon/phi/qwen) follow the same recipe once their model modules
-land.
+model's policy by HF ``model_type`` and assembles InferenceEngineV2.  Supported:
+llama, mistral (sliding window), mixtral (MoE), opt, falcon, phi, qwen2, gptj.
+(BLOOM serves through the v1 engine — ALiBi needs the biased dense attention,
+models/bloom.py.)
 """
 
 from typing import Any, Dict, Optional
@@ -14,11 +14,16 @@ from .engine_v2 import InferenceEngineV2
 
 
 def _registry():
-    from ...models import llama, mistral, mixtral
+    from ...models import falcon, gptj, llama, mistral, mixtral, opt, phi, qwen
     return {
         "llama": (llama, llama.config_from_hf),
         "mistral": (mistral, mistral.config_from_hf),
         "mixtral": (mixtral, None),  # config built field-by-field below
+        "opt": (opt, opt.config_from_hf),
+        "falcon": (falcon, falcon.config_from_hf),
+        "phi": (phi, phi.config_from_hf),
+        "qwen2": (qwen, qwen.config_from_hf),
+        "gptj": (gptj, gptj.config_from_hf),
     }
 
 

@@ -1,6 +1,8 @@
-from . import bert, gpt2, llama, transformer
+from . import bert, bloom, gpt2, gptj, llama, transformer
 from .bert import BertConfig
+from .bloom import BloomConfig
 from .gpt2 import GPT2Config
+from .gptj import GPTJConfig
 from .llama import LlamaConfig
 from . import mixtral
 from .mixtral import MixtralConfig

@@ -222,3 +222,74 @@ def test_eval_batch_under_sequence_parallel():
     ids = np.random.default_rng(0).integers(0, 64, (eng.train_batch_size, 32))
     loss = float(eng.eval_batch(llama.causal_lm_batch(ids)))
     assert np.isfinite(loss)
+
+
+# ------------------------------------------------- round-4 families: GPT-J, BLOOM
+def test_hf_gptj_parity():
+    """GPT-J's INTERLEAVED rotary (rotate_every_two) + parallel residual +
+    biased untied head must match HF exactly (reference replace_policy GPTJ)."""
+    from deepspeed_tpu.models import gptj
+    _hf_parity(gptj, lambda tr: tr.GPTJForCausalLM(tr.GPTJConfig(
+        vocab_size=99, n_embd=32, n_layer=2, n_head=4, rotary_dim=4,
+        n_positions=64, n_inner=None)))
+
+
+def test_hf_bloom_parity():
+    """BLOOM's ALiBi biases + embedding LayerNorm + per-head fused QKV must
+    match HF exactly (reference replace_policy BLOOM)."""
+    from deepspeed_tpu.models import bloom
+    _hf_parity(bloom, lambda tr: tr.BloomForCausalLM(tr.BloomConfig(
+        vocab_size=99, hidden_size=32, n_layer=2, n_head=4)))
+
+
+def test_gptj_paged_prefill_matches_forward():
+    from deepspeed_tpu.models import gptj
+    cfg = gptj.GPTJConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, seq=64, rotary_dim=4)
+    params = gptj.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    T = 12
+    prompts = np.stack([rng.integers(1, cfg.vocab_size, (T,)) for _ in range(2)])
+    cache = gptj.init_paged_cache(cfg, num_blocks=16, block_size=8, dtype=jnp.float32)
+    tables = np.full((2, 4), 15, np.int32)
+    tables[0, :2] = [0, 1]
+    tables[1, :2] = [2, 3]
+    logits, _ = gptj.forward_paged(
+        cfg, params, jnp.asarray(prompts), jnp.asarray([T, T]), jnp.asarray([0, 0]),
+        jnp.asarray(tables), cache, block_size=8)
+    ref = gptj.forward(cfg, params, prompts)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_bloom_incremental_decode_matches_forward():
+    """BLOOM v1 serving: prefill + 3 decode steps through forward_with_cache
+    equal the full forward's next-token logits at each position."""
+    from deepspeed_tpu.models import bloom
+    cfg = bloom.BloomConfig.tiny(vocab=96, hidden=32, layers=2, heads=4, seq=32)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(2))
+    rng = np.random.default_rng(2)
+    ids = rng.integers(1, cfg.vocab_size, (2, 9))
+    cache = bloom.init_cache(cfg, 2, max_seq=32, dtype=jnp.float32)
+    logits, cache = bloom.forward_with_cache(cfg, params, jnp.asarray(ids[:, :6]), cache)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(bloom.forward(cfg, params, ids[:, :6])),
+                               atol=2e-4, rtol=2e-4)
+    for t in range(6, 9):
+        step_logits, cache = bloom.forward_with_cache(cfg, params, jnp.asarray(ids[:, t:t + 1]), cache)
+        full = bloom.forward(cfg, params, ids[:, :t + 1])
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]), np.asarray(full[:, -1]),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_gptj_v2_tp2_token_identical():
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import gptj
+    from deepspeed_tpu.parallel import MeshTopology
+    cfg = gptj.GPTJConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, seq=128, rotary_dim=8)
+    params = gptj.init_params(cfg, jax.random.PRNGKey(3))
+    kw = dict(config={"dtype": "float32"}, num_blocks=64, block_size=8,
+              max_blocks_per_seq=8, token_budget=16, max_seqs_per_step=4)
+    single = InferenceEngineV2(gptj, cfg, params, **kw)
+    topo = MeshTopology.from_axis_dict({"tensor": 2, "data": -1})
+    sharded = InferenceEngineV2(gptj, cfg, params, topology=topo, **kw)
+    prompts = [[1, 2, 3, 4, 5], [9, 10, 11]]
+    assert sharded.generate(prompts, max_new_tokens=5) == single.generate(prompts, max_new_tokens=5)
