@@ -23,11 +23,38 @@ fsdp>1 sharded-step check.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+# -- global deadline (VERDICT r4 #1) ----------------------------------------
+# The driver runs `python bench.py` under a hard timeout; round 4 emitted its
+# single JSON line only after ALL legs finished and got killed (rc=124, empty
+# artifact).  Fix: a global budget checked BETWEEN legs (legs that would not
+# fit are skipped with a marker), the partial artifact rewritten to
+# BENCH_PARTIAL.json after every leg, and a SIGTERM/SIGINT handler that prints
+# the best-so-far JSON line before dying so even a mid-leg kill leaves a
+# parseable tail.
+_T0 = time.perf_counter()
+_TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "420"))
+_LATEST_LINE = None  # most recent consolidated artifact JSON line
+
+
+def _remaining() -> float:
+    return _TOTAL_BUDGET_S - (time.perf_counter() - _T0)
+
+
+def _on_term(signum, frame):  # noqa: ARG001 — signal signature
+    if _LATEST_LINE is not None:
+        print(_LATEST_LINE, flush=True)
+    os._exit(0 if _LATEST_LINE is not None else 124)
+
+
+signal.signal(signal.SIGTERM, _on_term)
+signal.signal(signal.SIGINT, _on_term)
 
 # bf16 peak FLOPs by TPU generation (per chip)
 PEAK_FLOPS = {
@@ -277,30 +304,30 @@ def _measure_h2d_mbps() -> float:
     return a.nbytes / (time.perf_counter() - t0) / 1e6
 
 
-def measure_training_infinity(on_tpu: bool):
-    """ZeRO-Infinity headline (VERDICT r3 #1): a Llama-2-7B-shaped model
-    (hidden 4096 x up to 32 layers) training REAL steps on ONE 16GB chip —
-    past the resident-state HBM wall (1.4B) — via NVMe layer streaming
-    (offload_param: nvme) with Adam moments pinned in host RAM
-    (offload_optimizer: cpu), all reached from config alone.  Matches the
-    reference's reach-beyond-HBM pitch (partition_parameters.py:1479 +
-    swap_tensor/partitioned_param_swapper.py:36).
+def measure_training_infinity(on_tpu: bool, budget_s: float | None = None):
+    """ZeRO-Infinity leg (VERDICT r3 #1, r4 #1): a Llama-shaped model training
+    REAL steps on ONE 16GB chip via NVMe layer streaming (offload_param: nvme)
+    with Adam moments pinned in host RAM (offload_optimizer: cpu), all reached
+    from config alone.  Matches the reference's reach-beyond-HBM pitch
+    (partition_parameters.py:1479 + swap_tensor/partitioned_param_swapper.py:36).
 
-    The layer count ADAPTS to the measured host->device bandwidth so the leg
-    fits a time budget (BENCH_INFINITY_BUDGET_S, default 900): on real TPU
-    hosts (PCIe, GB/s) that resolves to the full 32-layer 6.74B model; through
-    the ~20 MB/s axon dev tunnel it resolves to a smaller depth, and the full
+    BOTH the layer count and the layer width ADAPT to the measured host->device
+    bandwidth so the leg fits its budget (BENCH_INFINITY_BUDGET_S, default 120 —
+    r4's 900s default is why the artifact never landed): on real TPU hosts
+    (PCIe, GB/s) that resolves to the full-width (hidden 4096) Llama-2-7B
+    shape; through the ~20 MB/s axon dev tunnel it resolves to a narrower
+    hidden so the mechanism is still timed end-to-end in-budget, and the full
     6.7B number comes from the offline artifact INFINITY_r04.json (produced by
     benchmarks/run_infinity_7b.py) merged in below.
 
     Per-layer init uses broadcast-stacked leaves, so host memory stays at one
-    layer while up to 26 GB of fp32 master params shard onto disk."""
+    layer while the fp32 master params shard onto disk."""
     if not on_tpu:
         return {"infinity": "skipped_on_cpu"}
     import gc
     import shutil
 
-    if shutil.disk_usage("/tmp").free < 35 * (1 << 30):
+    if shutil.disk_usage("/tmp").free < 10 * (1 << 30):
         return {"infinity": "skipped_low_disk"}
 
     import jax
@@ -311,12 +338,32 @@ def measure_training_infinity(on_tpu: bool):
     from deepspeed_tpu.models.transformer import cross_entropy_loss, rms_norm, rotary_tables
 
     h2d_mbps = _measure_h2d_mbps()
-    budget_s = float(os.environ.get("BENCH_INFINITY_BUDGET_S", "900"))
-    # per layer per step: 2 uploads of 405 MB (bf16 compute copy, fwd + bwd)
-    # + ~1.6 s host AdamW (202M params) + ~2.3 s disk read+writeback
-    per_layer_s = 2 * 405.0 / max(h2d_mbps, 1.0) + 1.6 + 2.3
-    n_layers = int(min(32, max(2, budget_s / (2.2 * per_layer_s))))  # warm+timed+init slack
-    cfg = llama.LlamaConfig(num_layers=n_layers)  # llama2_7b shape at depth n_layers
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_INFINITY_BUDGET_S", "120"))
+    # shape ladder: (hidden, intermediate, heads, kv_heads); bf16 bytes/layer =
+    # 2 * (4*D*D + 3*D*F).  Pick the widest whose 2-layer proof (stream each
+    # layer up twice per step, 2 steps + warm + init slack) fits the budget.
+    # r5 calibration (in-tunnel, 15 MB/s): 7 layers of hidden-1024 measured
+    # warm_step 150s / step 68.5s — i.e. ~10 s/layer/step streamed plus ~80s
+    # of per-layer jit compiles in the warm step (amortized away by the
+    # persistent compilation cache on repeat runs, but budget for it cold).
+    COMPILE_SLACK_S = 60.0
+    shapes = [(4096, 11008, 32, 32), (2560, 6912, 20, 4), (2048, 5504, 16, 16),
+              (1024, 2816, 8, 8), (512, 1408, 8, 8)]
+    pick = shapes[-1]
+    for D_, F_, H_, KV_ in shapes:
+        layer_mb = 2 * (4 * D_ * D_ + 3 * D_ * F_) / 1e6
+        per_layer = 2 * layer_mb / max(h2d_mbps, 1.0) + layer_mb / 150.0
+        if 2 * per_layer * 3.0 + COMPILE_SLACK_S + 20.0 <= budget_s:
+            pick = (D_, F_, H_, KV_)
+            break
+    D_, F_, H_, KV_ = pick
+    layer_mb = 2 * (4 * D_ * D_ + 3 * D_ * F_) / 1e6
+    per_layer_s = 2 * layer_mb / max(h2d_mbps, 1.0) + layer_mb / 150.0
+    n_layers = int(min(32, max(2, (budget_s - COMPILE_SLACK_S - 20.0)
+                               / (3.0 * max(per_layer_s, 1e-3)))))
+    cfg = llama.LlamaConfig(hidden_size=D_, intermediate_size=F_, num_heads=H_,
+                            num_kv_heads=KV_, num_layers=n_layers)
     seq, micro = 2048, 1
     D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     H = cfg.num_heads
@@ -343,11 +390,12 @@ def measure_training_infinity(on_tpu: bool):
     def stacked(in_dim, out_dim):
         return np.broadcast_to(base((in_dim, out_dim), in_dim ** -0.5), (L, in_dim, out_dim))
 
+    kv_width = KV_ * (D_ // H_)  # GQA rungs project k/v to KV*head_dim, not D
     params = {
         "stem": {"embed": base((cfg.vocab_size, D), 0.02)},
         "layers": {
-            "attn": {"wq": stacked(D, D), "wk": stacked(D, D),
-                     "wv": stacked(D, D), "wo": stacked(D, D)},
+            "attn": {"wq": stacked(D, D), "wk": stacked(D, kv_width),
+                     "wv": stacked(D, kv_width), "wo": stacked(D, D)},
             "mlp": {"w_gate": stacked(D, F), "w_up": stacked(D, F),
                     "w_down": stacked(F, D)},
             "attn_norm": np.broadcast_to(np.ones(D, np.float32), (L, D)),
@@ -394,6 +442,7 @@ def measure_training_infinity(on_tpu: bool):
             return {"infinity": f"nonfinite loss {loss}"}
         out = {
             "infinity_params_b": round(n_params / 1e9, 2),
+            "infinity_hidden": D_,
             "infinity_layers": n_layers,
             "infinity_step_s": round(step_s, 1),
             "infinity_tok_s": round(micro * seq / step_s, 1),
@@ -465,6 +514,97 @@ def measure_decode(on_tpu: bool):
             "decode_model_params_m": round(llama.num_params(cfg) / 1e6, 1)}
 
 
+def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
+    """Drive the v2 engine through a continuous-batching scenario: requests
+    arrive (``arrivals``: {step_idx: [uids]}) WHILE earlier ones decode, so
+    SplitFuse actually mixes prefill chunks and decode singles in one ragged
+    batch.  Returns (total_new_tokens, elapsed_s, per-step latencies of
+    token-emitting steps)."""
+    produced = {u: 0 for u in range(len(prompts))}
+    done = set()
+    pending = dict(arrivals)
+    lats = []
+    tokens = 0
+    step_i = 0
+    stalled = 0
+    t_start = time.perf_counter()
+    while len(done) < len(prompts):
+        if step_i in pending:
+            uids = pending.pop(step_i)
+            eng.put(uids, [prompts[u] for u in uids])
+        t0 = time.perf_counter()
+        out = eng.step()  # host-synchronous: tokens are materialized ints
+        dt = time.perf_counter() - t0
+        if out:
+            lats.append(dt)
+            tokens += len(out)
+            stalled = 0
+        elif not pending and not any(s.pending_tokens > 0 and not s.done
+                                     for s in eng.manager.seqs.values()):
+            break
+        else:
+            # prefill chunks make progress without emitting; a long run of
+            # empty steps means the scheduler is starved (KV pool exhausted)
+            # — bail instead of spinning the global budget away
+            stalled += 1
+            if stalled > 100:
+                break
+        for uid in out:
+            produced[uid] += 1
+            if produced[uid] >= max_new:
+                eng.manager.seqs[uid].done = True
+                done.add(uid)
+                eng.flush(uid)
+        step_i += 1
+    return tokens, time.perf_counter() - t_start, lats
+
+
+def measure_serving_mixed(on_tpu: bool):
+    """Mixed prefill/decode continuous batching (VERDICT r4 #6): tokens/s and
+    tail latency with requests arriving while others decode — the scheduling
+    job Dynamic SplitFuse exists for (reference
+    blogs/deepspeed-fastgen/README.md:139,168; v2/scheduler.py can_schedule).
+    The identical scenario runs twice — the first pass compiles every
+    (n, t, b) bucket the arrival pattern touches, the second is the timed
+    measurement — so the figure is steady-state scheduling + compute, not
+    compile time."""
+    import jax
+
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_req, prompt_len, max_new = 16, 128, 32
+        num_blocks, block_size, maxb, budget, max_seqs = 2048, 32, 64, 512, 16
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+        n_req, prompt_len, max_new = 6, 16, 4
+        num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 16, 64, 8
+
+    eng = InferenceEngineV2(llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                            config={"dtype": "bfloat16" if on_tpu else "float32"},
+                            num_blocks=num_blocks, block_size=block_size,
+                            max_blocks_per_seq=maxb, token_budget=budget,
+                            max_seqs_per_step=max_seqs)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(n_req)]
+    # wave 1 at t=0, then two waves landing mid-decode of the previous ones
+    arrivals = {0: list(range(n_req // 2)),
+                n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
+                n_req // 4 + 12: list(range(3 * n_req // 4, n_req))}
+    _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
+    tokens, dt, lats = _run_serving_scenario(eng, prompts, arrivals, max_new)
+    if not lats:
+        return {"serving_mixed": "no tokens emitted"}
+    return {"serving_mixed_tok_s": round(tokens / dt, 1),
+            "serving_mixed_p50_step_ms": round(float(np.percentile(lats, 50)) * 1e3, 1),
+            "serving_mixed_p95_step_ms": round(float(np.percentile(lats, 95)) * 1e3, 1),
+            "serving_mixed_requests": n_req,
+            "serving_mixed_arrival_waves": 3}
+
+
 def measure_fsdp_virtual(timeout_s: int = 280):
     """Overlap-shape check: one ZeRO-3 step over a data=2 x fsdp=4 VIRTUAL CPU
     mesh in a subprocess (real fsdp>1 MFU needs a pod; this proves the sharded
@@ -516,47 +656,91 @@ def _test_lane_counts():
                            for l in data.get("lanes", [])}}
 
 
-def _leg(fn, *args):
-    """Run one bench leg; a failure becomes a reported string, never a lost
-    artifact."""
+def _leg(key, fn, *args):
+    """Run one bench leg; a failure becomes a reported string under the leg's
+    own key, never a lost artifact."""
     try:
         return fn(*args)
     except Exception as exc:  # noqa: BLE001 — the artifact must always print
-        return {fn.__name__.replace("measure_", ""): f"error: {type(exc).__name__}: {exc}"[:300]}
+        return {key: f"error: {type(exc).__name__}: {exc}"[:300]}
 
 
-def main():
-    import jax
-
-    on_tpu = jax.devices()[0].platform != "cpu"
-    train = _leg(measure_training, on_tpu)
-    big = _leg(measure_training_big, on_tpu)
-    longseq = _leg(measure_training_longseq, on_tpu)
-    decode = _leg(measure_decode, on_tpu)
-    bw = _leg(measure_collective_bw, 1 << 30 if on_tpu else 1 << 22,
-              50 if on_tpu else 5)
-    fsdp = _leg(measure_fsdp_virtual) if on_tpu else {"fsdp_virtual8": "skipped_on_cpu"}
-    infinity = _leg(measure_training_infinity, on_tpu)
-    lanes = _leg(_test_lane_counts)
-    mfu = train.pop("mfu", 0.0)
-    print(json.dumps({
+def _artifact(extra: dict) -> str:
+    mfu = extra.get("mfu", 0.0)
+    body = {k: v for k, v in extra.items() if k != "mfu"}
+    return json.dumps({
         "metric": "llama_zero3_bf16_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(mfu / TARGET_MFU, 4),
-        "extra": {
-            **train,
-            "zero_stage": 3,
-            "vs_ulysses_54pct": round(mfu / 0.54, 4),
-            **big,
-            **longseq,
-            **decode,
-            **bw,
-            **fsdp,
-            **infinity,
-            **lanes,
-        },
-    }))
+        "extra": {**body,
+                  "vs_ulysses_54pct": round(mfu / 0.54, 4),
+                  "bench_elapsed_s": round(time.perf_counter() - _T0, 1),
+                  "bench_budget_s": _TOTAL_BUDGET_S},
+    })
+
+
+def main():
+    global _LATEST_LINE
+    import jax
+
+    # persistent compilation cache: through the axon relay a trivial jit
+    # compile costs ~48s cold and ~2s cached, so cacheing is the difference
+    # between the artifact fitting its budget and not (real deployments set
+    # this too — compile time is pure waste on every restart)
+    try:
+        os.makedirs("/tmp/dstpu_jax_cache", exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/dstpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knob: compile costs stay, gating still works
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    extra = {"zero_stage": 3}
+
+    # (key, est_cost_s, thunk) — ordered by evidence value; a leg runs only if
+    # its estimated cost fits the remaining global budget (the headline
+    # training leg always runs).  est costs are r4 wall-clock + compile slack.
+    legs = [
+        ("train",   0,   lambda: measure_training(on_tpu)),
+        ("lanes",   0,   _test_lane_counts),  # file read — always runs
+        ("longseq", 90,  lambda: measure_training_longseq(on_tpu)),
+        ("decode",  100, lambda: measure_decode(on_tpu)),
+        ("bw",      40,  lambda: measure_collective_bw(1 << 30 if on_tpu else 1 << 22,
+                                                       50 if on_tpu else 5)),
+        ("infinity", 0,  None),  # placeholder — budget set from remaining budget
+        ("big",     55,  lambda: measure_training_big(on_tpu)),
+        ("serving_mixed", 70, lambda: measure_serving_mixed(on_tpu)),
+        ("fsdp",    0,   None),  # placeholder — timeout set from remaining budget
+    ]
+    partial_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_PARTIAL.json")
+    for key, est, thunk in legs:
+        if key == "fsdp":
+            if not on_tpu:
+                res = {"fsdp_virtual8": "skipped_on_cpu"}
+            elif _remaining() > 75:
+                res = _leg(key, measure_fsdp_virtual, int(min(_remaining() - 40, 150)))
+            else:
+                res = {"fsdp_virtual8": "skipped_budget"}
+        elif key == "infinity":
+            if _remaining() > 70:
+                res = _leg(key, measure_training_infinity, on_tpu,
+                           float(min(_remaining() - 25,
+                                     float(os.environ.get("BENCH_INFINITY_BUDGET_S", "120")))))
+            else:
+                res = _leg(key, lambda: {"infinity": "skipped_budget", **_infinity_offline()})
+        elif key != "train" and key != "lanes" and _remaining() < est:
+            res = {key: "skipped_budget"}
+        else:
+            res = _leg(key, thunk)
+        extra.update(res)
+        _LATEST_LINE = _artifact(extra)
+        tmp = partial_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(_LATEST_LINE + "\n")
+        os.replace(tmp, partial_path)
+    print(_LATEST_LINE, flush=True)
 
 
 if __name__ == "__main__":

@@ -375,6 +375,21 @@ class SwappedLayerTrainer:
         loss, grads = self._head_jit(head32, x, jnp.asarray(batch["y"]))
         return loss, grads[0], grads[1]
 
+    # ------------------------------------------------------------- export
+    def gather_stacked_params(self):
+        """Re-stack the NVMe-resident fp32 master params into the [L, ...]
+        host pytree they were initialized from — the zero_to_fp32 analog for
+        the streamed path (reference utils/zero_to_fp32.py consolidates
+        partitioned masters the same way, one shard at a time)."""
+        per_layer = []
+        for l in range(self.num_layers):
+            host = self.swapper.wait_in(self._pkey(l))
+            per_layer.append([np.array(a, np.float32) for a in host])
+            self.swapper.release(self._pkey(l))
+        stacked = [np.stack([per_layer[l][i] for l in range(self.num_layers)])
+                   for i in range(len(per_layer[0]))]
+        return jax.tree_util.tree_unflatten(self._layer_treedef, stacked)
+
     # ---------------------------------------------------------- inference
     def forward(self, x: np.ndarray):
         if self.stem_fn is not None:
