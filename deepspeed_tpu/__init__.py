@@ -80,19 +80,32 @@ def initialize(args=None,
                  f"through models.transformer.attention_block", ranks=[0])
     fn = _transformer.scoped_default_attention(fn, sparse_fn)
 
+    # Random-LTD (reference convert_to_random_ltd rewrites modules from config
+    # alone, data_routing/helper.py:11): scope an LTD state around the loss_fn
+    # the same way sparse attention is scoped.  Model forwards that support
+    # token dropping (the in-repo zoo routes through transformer.random_ltd_scan)
+    # read it at trace time; the engine ramps state["keep"] on the reference
+    # schedule and re-jits at each budget step.  Opaque loss_fns that ignore
+    # the state still get the loud warning below.
+    ltd_state = None
+    if cfg.data_efficiency.enabled and cfg.data_efficiency.data_routing.enabled:
+        from .runtime.data_pipeline.random_ltd import RandomLTDScheduler
+        from .utils.logging import log_dist
+        scheduler = RandomLTDScheduler(cfg.data_efficiency.data_routing.random_ltd)
+        ltd_state = {"keep": scheduler.current_tokens, "scheduler": scheduler}
+        fn = _transformer.scoped_random_ltd(fn, ltd_state)
+        log_dist(f"data_routing: random-LTD scoped as this engine's token-drop "
+                 f"state (keep ramps {scheduler.min_tokens}->{scheduler.max_tokens} "
+                 f"over {scheduler.total_steps} steps).  Engages for forwards that "
+                 f"read configured_ltd() and take rng (llama-family zoo models); "
+                 f"the engine warns after step 1 if the traced loss_fn never "
+                 f"engaged it", ranks=[0])
+
     engine = Engine(loss_fn=fn, params=model_parameters, config=cfg, topology=topology, tp_rules=tp_rules,
                     param_init_fn=param_init_fn,
+                    ltd_state=ltd_state,
                     layer_fn=kwargs.pop("layer_fn", None), head_fn=kwargs.pop("head_fn", None),
                     stem_fn=kwargs.pop("stem_fn", None))
-
-    if cfg.data_efficiency.enabled and cfg.data_efficiency.data_routing.enabled:
-        from .utils.logging import logger
-        logger.warning(
-            "data_efficiency.data_routing (random-LTD) is enabled in config, but the "
-            "engine cannot rewrite an opaque loss_fn — apply "
-            "runtime.data_pipeline.random_ltd in the model's layer stack "
-            "(reference convert_to_random_ltd rewrites modules; the functional "
-            "analog is a model-side opt-in)")
 
     dataloader = None
     if training_data is not None:

@@ -11,9 +11,10 @@ ALiBi: each head h adds slope_h * key_index to its attention scores — the
 key-only form is softmax-equivalent to the relative-distance form (each query
 row differs by a constant), which is exactly how HF builds the bias
 (modeling_bloom.build_alibi_tensor).  Attention runs through a local
-biased-sdpa (the generic attention_fn hook has no bias slot); serving goes
-through ``forward_with_cache`` (v1 incremental decoding) — the Pallas paged
-kernel has no bias input yet, so no forward_paged.
+biased-sdpa in training (the generic attention_fn hook has no bias slot);
+serving goes through ``forward_with_cache`` (v1) or ``forward_paged`` (v2
+ragged serving — the paged kernel's ``alibi_slopes`` operand carries the
+key-only bias, ops/attention/paged.py).
 """
 
 import dataclasses
@@ -215,6 +216,72 @@ def forward_with_cache(config: BloomConfig, params, input_ids, cache, attention_
     x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
     logits = x @ params["embed"].T.astype(x.dtype)
     return logits, {"k": new_k, "v": new_v, "len": start + s}
+
+
+def init_paged_cache(config: BloomConfig, num_blocks: int, block_size: int,
+                     dtype=jnp.bfloat16):
+    from .transformer import init_paged_kv_pool
+    return init_paged_kv_pool(config.num_layers, config.num_heads,
+                              config.hidden_size // config.num_heads,
+                              num_blocks, block_size, dtype)
+
+
+def forward_paged(config: BloomConfig, params, tokens, n_tokens, start_pos, block_tables,
+                  kv_cache, *, block_size: int, tp_axis: Optional[str] = None,
+                  gather_logits: bool = True):
+    """Ragged chunked BLOOM forward — ALiBi rides the paged kernel's
+    ``alibi_slopes`` operand (key-only form, absolute key index), making BLOOM
+    the 9th paged family (the reference's v2 zoo doesn't serve BLOOM at all;
+    its v1 path injects ALiBi through the softmax op binding,
+    ops/transformer/inference/op_binding/softmax.py).
+
+    TP: fused per-head-interleaved qkv is column-sharded on head boundaries
+    (tp_rules), so the local shard holds H/tp whole heads; each shard slices
+    its own run of the slope schedule by mesh position.  The tied unembedding
+    uses the replicated embedding, so logits come out full-vocab on every
+    shard (no gather needed)."""
+    from ..ops.attention.paged import paged_attention
+    from .transformer import paged_chunk_indices
+
+    b, tchunk = tokens.shape
+    Dh = config.hidden_size // config.num_heads
+    H = params["layers"]["w_qkv"].shape[-1] // (3 * Dh)  # local heads
+    scale = 1.0 / np.sqrt(Dh)
+    slopes = jnp.asarray(alibi_slopes(config.num_heads))
+    if tp_axis is not None and H < config.num_heads:
+        off = jax.lax.axis_index(tp_axis).astype(jnp.int32) * H
+        slopes = jax.lax.dynamic_slice(slopes, (off,), (H,))
+    safe_pos, valid, lengths, blk, off_tok = paged_chunk_indices(
+        tokens, n_tokens, start_pos, block_tables, kv_cache["k"].shape[1], block_size)
+    x = params["embed"][tokens].astype(kv_cache["k"].dtype)
+    x = layer_norm(x, params["embed_ln_w"], params["embed_ln_b"], config.ln_eps)
+    head_idx = jnp.arange(H)[None, None, :]
+    preduce = (lambda y: jax.lax.psum(y, tp_axis)) if tp_axis else (lambda y: y)
+
+    def layer(x, inp):
+        lp, kpool, vpool = inp
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], config.ln_eps)
+        qkv = h @ lp["w_qkv"].astype(x.dtype) + lp["b_qkv"].astype(x.dtype)
+        fused = qkv.reshape(b, tchunk, H, 3, Dh)
+        q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+        kpool = kpool.at[blk[:, :, None], head_idx, off_tok[:, :, None]].set(k)
+        vpool = vpool.at[blk[:, :, None], head_idx, off_tok[:, :, None]].set(v)
+        out = paged_attention(q, kpool, vpool, block_tables, lengths, start_pos, n_tokens,
+                              block_size=block_size, softmax_scale=scale,
+                              alibi_slopes=slopes)
+        x = x + preduce(out.reshape(b, tchunk, H * Dh) @ lp["wo"].astype(x.dtype)) \
+              + lp["bo"].astype(x.dtype)
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], config.ln_eps)
+        h = jax.nn.gelu(h @ lp["fc1"].astype(x.dtype) + lp["b_fc1"].astype(x.dtype),
+                        approximate=True)
+        x = x + preduce(h @ lp["fc2"].astype(x.dtype)) + lp["b_fc2"].astype(x.dtype)
+        return x, (kpool, vpool)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], config.ln_eps)
+    logits = x @ params["embed"].T.astype(x.dtype)
+    del gather_logits  # tied head is replicated: logits are already full-vocab
+    return logits, {"k": new_k, "v": new_v}
 
 
 # ----------------------------------------------------------------- HF import

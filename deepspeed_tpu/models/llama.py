@@ -86,11 +86,12 @@ def init_params(config: LlamaConfig, key, dtype=jnp.float32):
 def _layer_fn(config: LlamaConfig, cos, sin, attention_fn=None):
     from ..runtime.activation_checkpointing import checkpoint_name
 
-    def layer(x, layer_params):
+    def layer(x, layer_params, positions=None):
         attn_in = rms_norm(x, layer_params["attn_norm"], config.rms_eps)
         attn_out, _ = attention_block(layer_params["attn"], attn_in,
                                       n_heads=config.num_heads, n_kv_heads=config.num_kv_heads,
-                                      cos=cos, sin=sin, causal=True, attention_fn=attention_fn)
+                                      cos=cos, sin=sin, causal=True, attention_fn=attention_fn,
+                                      positions=positions)
         # residual-stream names: identity unless an offload/naming remat policy
         # targets them (runtime/activation_checkpointing.py RESIDUAL_NAMES)
         x = checkpoint_name(x + attn_out, "attn_resid")
@@ -101,15 +102,23 @@ def _layer_fn(config: LlamaConfig, cos, sin, attention_fn=None):
     return layer
 
 
-def forward(config: LlamaConfig, params, input_ids, attention_fn=None):
-    """input_ids [B, S] -> logits [B, S, V]."""
+def forward(config: LlamaConfig, params, input_ids, attention_fn=None, rng=None):
+    """input_ids [B, S] -> logits [B, S, V].  When an engine-scoped random-LTD
+    state is configured (initialize() with data_efficiency.data_routing) and an
+    ``rng`` is provided, middle layers process a random token subset
+    (transformer.random_ltd_scan)."""
+    from .transformer import configured_ltd, random_ltd_scan
     cos, sin = rotary_tables(config.hidden_size // config.num_heads, config.max_seq_len, config.rope_theta)
     x = params["embed"][input_ids]  # keep embed dtype (engine casts params)
     layer = _layer_fn(config, cos, sin, attention_fn)
     if config.remat:
         from ..runtime.activation_checkpointing import resolve_policy
         layer = jax.checkpoint(layer, policy=resolve_policy(config.remat_policy))
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    ltd = configured_ltd()
+    if ltd is not None and rng is not None:
+        x = random_ltd_scan(layer, x, params["layers"], rng, int(ltd["keep"]))
+    else:
+        x, _ = jax.lax.scan(layer, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     return x @ head.astype(x.dtype)
@@ -120,7 +129,8 @@ def make_loss_fn(config: LlamaConfig, attention_fn=None) -> Callable:
     (labels = input_ids shifted; -100 = ignore)."""
 
     def loss_fn(params, batch, rng):
-        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn)
+        logits = forward(config, params, batch["input_ids"], attention_fn=attention_fn,
+                         rng=rng)
         return cross_entropy_loss(logits, batch["labels"])
 
     return loss_fn

@@ -66,9 +66,10 @@ def apply_rotary(x, cos, sin, positions=None):
 
 
 # ----------------------------------------------------------------- attention
-def sdpa(q, k, v, causal=True, mask=None, softmax_scale=None):
+def sdpa(q, k, v, causal=True, mask=None, softmax_scale=None, bias=None):
     """Scaled dot-product attention. q,k,v: [B, S, H, D] (k/v may have fewer
-    heads — GQA — broadcast via repeat). fp32 softmax for stability."""
+    heads — GQA — broadcast via repeat). fp32 softmax for stability.
+    ``bias``: additive logit bias broadcastable to [B, H, Sq, Sk] (ALiBi)."""
     b, sq, hq, d = q.shape
     hk = k.shape[2]
     if hk != hq:
@@ -77,6 +78,8 @@ def sdpa(q, k, v, causal=True, mask=None, softmax_scale=None):
         v = jnp.repeat(v, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     sk = k.shape[1]
     if causal:
         # support sq != sk (decode): query i attends keys <= i + (sk - sq)
@@ -137,6 +140,85 @@ def scoped_default_attention(loss_fn, attention_fn):
 
 def configured_attention_engaged() -> bool:
     return _CONFIGURED_ATTENTION["engaged"]
+
+
+# ------------------------------------------------------- random-LTD scoping
+# Engine-side random-LTD activation for the in-repo zoo (reference
+# convert_to_random_ltd rewrites nn.Modules from config alone,
+# runtime/data_pipeline/data_routing/helper.py:11).  The functional analog:
+# initialize() scopes an LTD state around the loss_fn exactly like the sparse-
+# attention default above; model forwards that support token dropping read it
+# at TRACE time via configured_ltd().  ``state["keep"]`` is a python int —
+# baked into the trace — so the engine re-jits when the scheduler's budget
+# steps (the reference pays the same recompile via its seqlen buckets).
+_CONFIGURED_LTD = {"state": None, "engaged": False}
+
+
+def scoped_random_ltd(loss_fn, ltd_state):
+    """Pin ``ltd_state`` as the configured random-LTD while loss_fn traces
+    (``None`` pins the scope EMPTY — how the engine's eval step keeps LTD
+    train-only).  Engagement is recorded on the state dict itself
+    (``ltd_state["engaged"]``), so each engine sees its own truth rather than
+    a process-global flag."""
+
+    def scoped(*args, **kwargs):
+        prev = _CONFIGURED_LTD["state"]
+        _CONFIGURED_LTD["state"] = ltd_state
+        if ltd_state is not None:
+            _CONFIGURED_LTD["engaged"] = False  # fresh trace, fresh verdict
+        try:
+            return loss_fn(*args, **kwargs)
+        finally:
+            _CONFIGURED_LTD["state"] = prev
+
+    return scoped
+
+
+def configured_ltd():
+    return _CONFIGURED_LTD["state"]
+
+
+def configured_ltd_engaged() -> bool:
+    return _CONFIGURED_LTD["engaged"]
+
+
+def random_ltd_scan(layer, x, stacked_params, rng, keep: int):
+    """Scan a layer stack with random layerwise token dropping: first and last
+    layers see every token (reference random_ltd keeps the outer layers
+    intact); each middle layer processes an independent random subset of
+    ``keep`` tokens — dropped tokens ride the residual stream unchanged —
+    with rotary/causal math on ORIGINAL positions via the layer's
+    ``positions`` argument.  Cuts middle-layer attention cost by (keep/S)^2
+    (reference csrc/random_ltd token_sort/gather kernels; here the sort/
+    gather is jnp.take/at[].set and XLA fuses it)."""
+    from ..runtime.data_pipeline.random_ltd import (gather_tokens,
+                                                    sample_token_indices,
+                                                    scatter_tokens)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    L = int(leaves[0].shape[0])
+    S = x.shape[1]
+    take = lambda i: jax.tree_util.tree_map(lambda l: l[i], stacked_params)
+    if L < 3 or keep >= S:
+        x, _ = jax.lax.scan(layer, x, stacked_params)
+        return x
+    _CONFIGURED_LTD["engaged"] = True
+    st = _CONFIGURED_LTD["state"]
+    if st is not None:
+        st["engaged"] = True  # per-engine truth (the global resets each trace)
+    x, _ = layer(x, take(0))
+    mids = jax.tree_util.tree_map(lambda l: l[1:-1], stacked_params)
+
+    def mid_body(carry, lp):
+        h, key = carry
+        key, sub = jax.random.split(key)
+        idx = sample_token_indices(sub, S, keep)
+        kept = gather_tokens(h, idx)
+        y, _ = layer(kept, lp, positions=idx[None, :])  # [1, K]: original rotary positions
+        return (scatter_tokens(h, y, idx), key), None
+
+    (x, _), _ = jax.lax.scan(mid_body, (x, rng), mids)
+    x, _ = layer(x, take(L - 1))
+    return x
 
 
 def _resolve_attention(attention_fn):

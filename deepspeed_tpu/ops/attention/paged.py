@@ -36,8 +36,12 @@ from .._pallas import use_pallas as _use_pallas
 NEG_INF = -1e30
 
 
-def _paged_kernel(tables_ref, lengths_ref, start_ref, ntok_ref, q_ref, k_ref,
-                  v_ref, o_ref, acc, m_sc, l_sc, *, scale, block_size, t_pad, window):
+def _paged_kernel(tables_ref, lengths_ref, start_ref, ntok_ref, *rest,
+                  scale, block_size, t_pad, window, alibi):
+    if alibi:
+        slopes_ref, q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc, m_sc, l_sc = rest
     n, h, b = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nb = pl.num_programs(2)
 
@@ -59,6 +63,11 @@ def _paged_kernel(tables_ref, lengths_ref, start_ref, ntok_ref, q_ref, k_ref,
         kpos = b * block_size + jax.lax.broadcasted_iota(jnp.int32, (t_pad, block_size), 1)
         t_iota = jax.lax.broadcasted_iota(jnp.int32, (t_pad, block_size), 0)
         qp = start_ref[n] + t_iota  # absolute query positions
+        if alibi:
+            # ALiBi key-only form: slope_h * absolute key index (softmax-
+            # equivalent to the relative-distance form per query row —
+            # models/bloom.py docstring; HF build_alibi_tensor)
+            s = s + slopes_ref[h] * kpos.astype(jnp.float32)
         mask = (kpos <= qp) & (kpos < length) & (t_iota < ntok_ref[n])
         if window is not None:
             mask = jnp.logical_and(mask, kpos > qp - window)
@@ -84,26 +93,31 @@ def _paged_kernel(tables_ref, lengths_ref, start_ref, ntok_ref, q_ref, k_ref,
 
 def paged_attention(q, kpool, vpool, tables, lengths, start_pos, n_tokens, *,
                     block_size: int, softmax_scale: Optional[float] = None,
-                    window: Optional[int] = None):
+                    window: Optional[int] = None, alibi_slopes=None):
     """q [N, T, H, Dh]; kpool/vpool [NB, KV, bs, Dh]; tables [N, MAXB] int32;
     lengths/start_pos/n_tokens [N] int32.  Returns [N, T, H, Dh] (rows at
-    t >= n_tokens[n] are zero).  ``window`` = sliding-window size (Mistral)."""
+    t >= n_tokens[n] are zero).  ``window`` = sliding-window size (Mistral);
+    ``alibi_slopes`` [H] f32 adds slope_h * key_index to the scores (BLOOM —
+    reference serves ALiBi through its softmax op's alibi path,
+    ops/transformer/inference/op_binding/softmax.py)."""
     n, t, hq, dh = q.shape
     kvh, bs = kpool.shape[1], kpool.shape[2]
     maxb = tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(dh))
     if not _use_pallas():
         return _dense_fallback(q, kpool, vpool, tables, lengths, start_pos, n_tokens,
-                               scale, window)
+                               scale, window, alibi_slopes)
 
     group = hq // kvh
     t_pad = max(8, int(np.ceil(t / 8)) * 8)
     qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
 
+    alibi = alibi_slopes is not None
     kernel = functools.partial(_paged_kernel, scale=scale, block_size=bs,
-                               t_pad=t_pad, window=window)
+                               t_pad=t_pad, window=window, alibi=alibi)
+    nsp = 5 if alibi else 4
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=nsp,
         grid=(n, hq, maxb),
         in_specs=[
             pl.BlockSpec((1, 1, t_pad, dh), lambda ni, h, b, *refs: (ni, h, 0, 0)),
@@ -119,6 +133,10 @@ def paged_attention(q, kpool, vpool, tables, lengths, start_pos, n_tokens, *,
             pltpu.VMEM((t_pad, 128), jnp.float32),
         ],
     )
+    scalars = [tables.astype(jnp.int32), lengths.astype(jnp.int32),
+               start_pos.astype(jnp.int32), n_tokens.astype(jnp.int32)]
+    if alibi:
+        scalars.append(jnp.asarray(alibi_slopes, jnp.float32))
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -126,12 +144,12 @@ def paged_attention(q, kpool, vpool, tables, lengths, start_pos, n_tokens, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
-    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
-      start_pos.astype(jnp.int32), n_tokens.astype(jnp.int32), qt, kpool, vpool)
+    )(*scalars, qt, kpool, vpool)
     return out[:, :, :t].transpose(0, 2, 1, 3)
 
 
-def _dense_fallback(q, kpool, vpool, tables, lengths, start_pos, n_tokens, scale, window):
+def _dense_fallback(q, kpool, vpool, tables, lengths, start_pos, n_tokens, scale,
+                    window, alibi_slopes=None):
     """Reference-math path: gather the whole table, masked sdpa (the v2
     engine's original implementation — kept as the CPU/parity baseline)."""
     from ...models.transformer import sdpa
@@ -147,5 +165,10 @@ def _dense_fallback(q, kpool, vpool, tables, lengths, start_pos, n_tokens, scale
     mask = (kpos <= qp) & (kpos < lengths[:, None, None]) & (qp >= 0)
     if window is not None:
         mask = jnp.logical_and(mask, kpos > qp - window)
-    out = sdpa(q, ctx_k, ctx_v, causal=False, mask=mask[:, None, :, :], softmax_scale=scale)
+    bias = None
+    if alibi_slopes is not None:
+        bias = (jnp.asarray(alibi_slopes, jnp.float32)[None, :, None, None]
+                * jnp.arange(maxb * bs, dtype=jnp.float32)[None, None, None, :])
+    out = sdpa(q, ctx_k, ctx_v, causal=False, mask=mask[:, None, :, :],
+               softmax_scale=scale, bias=bias)
     return jnp.where((qp >= 0)[..., None], out, 0.0)

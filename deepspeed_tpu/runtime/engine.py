@@ -89,9 +89,13 @@ class Engine:
                  param_init_fn: Optional[Callable] = None,
                  layer_fn: Optional[Callable] = None,
                  head_fn: Optional[Callable] = None,
-                 stem_fn: Optional[Callable] = None):
+                 stem_fn: Optional[Callable] = None,
+                 ltd_state: Optional[dict] = None):
         self.config = config
         self._stem_fn = stem_fn
+        # random-LTD ramp state ({"keep", "scheduler"}) — train_batch re-jits
+        # the step when the scheduler moves the kept-token budget
+        self._ltd_state = ltd_state
         self.loss_fn = loss_fn
         self.topology = topology or MeshTopology.build(_mesh_config_for(config))
         set_topology(self.topology)
@@ -655,6 +659,22 @@ class Engine:
             self.lr_scheduler.last_step = self.global_steps
             self._maybe_report(metrics)
             return metrics
+        if self._ltd_state is not None:
+            if self.global_steps == 1 and not self._ltd_state.get("engaged"):
+                from ..utils.logging import logger
+                logger.warning(
+                    "data_routing.random_ltd is configured but the first traced step "
+                    "never engaged token dropping — this loss_fn does not read "
+                    "configured_ltd() (llama-family forwards with an rng do); "
+                    "training proceeds WITHOUT random-LTD")
+            new_keep = self._ltd_state["scheduler"].update_seq(self.global_steps)
+            if new_keep != self._ltd_state["keep"]:
+                # the kept-token count is a static shape in the traced program
+                # (reference random-LTD pays the same via its seqlen buckets):
+                # bump it and rebuild the jitted step at the new budget
+                self._ltd_state["keep"] = new_keep
+                self._compiled_step = None
+                self._offload_grad_fn = None  # offload path re-traces at the new budget
         breakdown = self.config.wall_clock_breakdown
         t0 = time.perf_counter() if breakdown else 0.0
         batch = self._ensure_gas_layout(batch)
@@ -731,9 +751,17 @@ class Engine:
         if self._compiled_eval is None:
             compute_dtype = self.compute_dtype
 
+            loss_fn = self.loss_fn
+            if self._ltd_state is not None:
+                # random-LTD is train-only (reference applies it via the
+                # training forward rewrite): eval traces with the LTD scope
+                # pinned empty so the full model is measured
+                from ..models.transformer import scoped_random_ltd
+                loss_fn = scoped_random_ltd(loss_fn, None)
+
             def eval_step(params, b, rng):
                 p16 = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
-                out = self.loss_fn(p16, b, rng)
+                out = loss_fn(p16, b, rng)
                 return out[0] if isinstance(out, tuple) else out
 
             self._compiled_eval = jax.jit(eval_step)

@@ -102,11 +102,13 @@ def test_paged_attention_kernel_parity():
     old = _pallas.INTERPRET
     _pallas.INTERPRET = True
     try:
-        for window in (None, 6):
+        slopes = jnp.asarray([0.5, 0.25, 0.125, 0.0625], jnp.float32)  # [H]
+        for window, alibi in ((None, None), (6, None), (None, slopes)):
             ref = _dense_fallback(q, kpool, vpool, tables, lengths, start_pos,
-                                  n_tokens, scale, window)
+                                  n_tokens, scale, window, alibi)
             got = paged_attention(q, kpool, vpool, tables, lengths, start_pos,
-                                  n_tokens, block_size=BS, window=window)
+                                  n_tokens, block_size=BS, window=window,
+                                  alibi_slopes=alibi)
             valid = np.asarray(jnp.arange(T)[None, :] < n_tokens[:, None])
             np.testing.assert_allclose(np.asarray(got)[valid], np.asarray(ref)[valid],
                                        atol=2e-5)
