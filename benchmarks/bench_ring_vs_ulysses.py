@@ -12,6 +12,11 @@ stops scaling entirely.
 Runs on a virtual 8-device CPU mesh: per-device peak bytes come from XLA's
 compiled memory analysis (no OOM roulette), wall time from a small-S run.
 Emits one JSON line.
+
+Real-chip wall-clock for the v3 ring (Pallas flash inner with lse + zigzag
+causal schedule) is measured by ``bench.py measure_ring`` — recorded in the
+driver artifact as ring_inner_speedup / ring_causal_schedule_speedup /
+ring_zigzag_vs_ulysses.
 """
 
 import json

@@ -209,7 +209,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, res, g, g_lse=None):
+    """``g_lse`` [B,H,Sq]: cotangent on the logsumexp output (flash_with_lse).
+    It folds into the existing delta term: dL/ds_ij gains g_lse_i * p_ij, and
+    since ds = p * (dp - delta) * scale, passing delta' = delta - g_lse
+    computes the lse contribution with ZERO extra kernel work."""
     q, k, v, out, lse = res
     do = g
     b, sq, hq, d = q.shape
@@ -222,6 +226,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g):
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,S,H]
     delta = delta.transpose(0, 2, 1)  # [B,H,S]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     def padq(x):  # [B,S,H,D] -> [B,H,Sp,D]
         return jnp.pad(x.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - x.shape[1]), (0, 0)))
@@ -314,6 +320,53 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_lse_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(scale, causal, block_q, block_k, res, g):
+    g_out, g_lse = g
+    return _flash_bwd(scale, causal, block_q, block_k, res, g_out, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             softmax_scale: Optional[float] = None,
+                             block_q: int = 512, block_k: int = 512):
+    """Flash attention returning (out [B,Sq,H,D], lse [B,H,Sq]) — the form a
+    blockwise/ring outer loop needs to merge per-block results (VERDICT r4 #3:
+    'expose logsumexp and let the ring dispatch to it').  Differentiable in
+    BOTH outputs: the lse cotangent folds into the backward kernels' delta
+    term, so ring gradients cost the same as plain flash gradients.  Supports
+    sq != sk with the same absolute-position causal offset as the main kernel
+    (queries sit at the END of the key sequence — exactly the zigzag ring's
+    high-chunk diagonal step).  Off-TPU falls back to a dense XLA path (same
+    fallback contract as flash_attention)."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / float(np.sqrt(d))
+    if not _use_pallas():
+        hq, hk = q.shape[2], k.shape[2]
+        kk = jnp.repeat(k, hq // hk, axis=2) if hq != hk else k
+        vv = jnp.repeat(v, hq // hk, axis=2) if hq != hk else v
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale
+        if causal:
+            sq, sk = q.shape[1], k.shape[1]
+            qpos = jnp.arange(sq)[:, None] + (sk - sq)
+            s = jnp.where((jnp.arange(sk)[None, :] <= qpos)[None, None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), vv).astype(q.dtype)
+        return out, lse
+    return _flash_lse(q, k, v, scale, causal, block_q, block_k)
 
 
 def flash_attention(q, k, v, causal: bool = True, mask=None,

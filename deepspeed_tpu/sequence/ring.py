@@ -10,14 +10,20 @@ by XLA with the block matmuls.
 v2 (round 4, VERDICT r3 #5):
 - GQA runs grouped (q reshaped [B, s, hk, g, d]) — no ``jnp.repeat`` KV
   materialization.
-- The inner block attention is a chunked online-softmax scan with
-  flash-equivalent O(s·chunk) live memory, differentiable end-to-end (see
-  _block_attention for why a raw pallas_call fwd can't be the default here).
-- Causal rings skip fully-masked steps: at step j only ranks my >= j compute
-  (``lax.cond`` on the block source), so aggregate FLOPs drop ~2x; the
-  ppermute still runs every step (it's the collective schedule).
+- Causal rings skip fully-masked steps via ``lax.cond`` (aggregate FLOPs
+  ~2x down — kept as the odd-local-seq fallback).
 - Per-block (out, lse) pairs merge in the numerically-stable weighted form,
   so the inner attention can be ANY kernel that returns logsumexp.
+
+v3 (round 5, VERDICT r4 #3):
+- The inner block attention IS the Pallas flash kernel on TPU
+  (flash_attention_with_lse): fused fwd, and a backward whose lse cotangent
+  folds into the existing delta term — ring gradients run at flash-kernel
+  speed.  The chunked online-softmax scan remains the CPU/parity fallback.
+- Causal rings use the ZIGZAG layout (rank r holds global half-chunks
+  (r, 2P-1-r)): v2's cond-skip saved aggregate FLOPs but rank P-1 still
+  computed every step, so wall-clock didn't move; zigzag gives every rank
+  the same s x s/2 live area per step — causal wall-clock ~halves.
 
 Comm volume matches Ulysses per link but removes the all-to-all's full-mesh
 traffic pattern (pure neighbor exchange — ideal for TPU ICI rings), and scales
@@ -40,38 +46,45 @@ NEG_INF = -1e30
 
 
 def _block_attention(q, k, v, causal: bool, scale: float, chunk: int = 1024):
-    """One block-pair attention returning (out [B,s,hq,d] fp32 — normalized
-    within the block, lse [B,s,hq,1] fp32).
+    """One block-pair attention returning (out [B,sq,hq,d] fp32 — normalized
+    within the block, lse [B,sq,hq,1] fp32).  Supports sq != sk with the
+    flash-kernel convention: queries sit at the END of the key sequence
+    (causal offset sk - sq) — the zigzag schedule's high-chunk diagonal.
 
-    Flash-equivalent memory in pure XLA: an online-softmax ``lax.scan`` over
-    K-chunks keeps live scores at O(s·chunk) instead of O(s²) — so the ring's
-    per-chip activation memory really is O(s/P·chunk), and the whole ring
-    stays differentiable (a raw pallas_call fwd would not be; the chunk body
-    is ``jax.checkpoint``ed so the backward recomputes per chunk rather than
-    saving every chunk's probabilities).  GQA stays grouped (q reshaped to
-    [B,s,hk,g,d]) — no repeated-KV materialization.  A fused Pallas ring
-    kernel (block compute + ppermute in one kernel) is the remaining perf
-    lever; this form already MXU-tiles via the chunk matmuls."""
-    b, s, hq, d = q.shape
-    hk = k.shape[2]
+    On TPU this IS the Pallas flash kernel (ops/attention/flash.py
+    flash_attention_with_lse — VERDICT r4 #3: the ring's inner loop fused;
+    the lse cotangent folds into the kernel's delta term so the ring stays
+    differentiable end-to-end at kernel speed).  Off-TPU the chunked
+    online-softmax ``lax.scan`` below is the numerically-identical fallback:
+    O(s·chunk) live memory, ``jax.checkpoint``ed chunk body, grouped GQA (no
+    repeated-KV materialization)."""
+    from ..ops import _pallas as _p
+    b, sq_len, hq, d = q.shape
+    sk_len = k.shape[1]
+    if _p.use_pallas():
+        from ..ops.attention.flash import flash_attention_with_lse
+        o, lse = flash_attention_with_lse(q, k, v, causal=causal, softmax_scale=scale)
+        return (o.astype(jnp.float32),
+                lse.transpose(0, 2, 1)[..., None].astype(jnp.float32))
+    s, hk = sq_len, k.shape[2]
     g = hq // hk
-    C = min(chunk, s)
-    n_chunks = -(-s // C)
-    pad = n_chunks * C - s
+    C = min(chunk, sk_len)
+    n_chunks = -(-sk_len // C)
+    pad = n_chunks * C - sk_len
     qf = q.astype(jnp.float32).reshape(b, s, hk, g, d)
     kf = jnp.pad(k.astype(jnp.float32), ((0, 0), (0, pad), (0, 0), (0, 0)))
     vf = jnp.pad(v.astype(jnp.float32), ((0, 0), (0, pad), (0, 0), (0, 0)))
     kc = kf.reshape(b, n_chunks, C, hk, d).transpose(1, 0, 2, 3, 4)  # [n, b, C, hk, d]
     vc = vf.reshape(b, n_chunks, C, hk, d).transpose(1, 0, 2, 3, 4)
-    qpos = jnp.arange(s)
+    qpos = jnp.arange(s) + (sk_len - s)  # absolute positions in the key frame
 
     def body(carry, inp):
         acc, l, m = carry
         k_blk, v_blk, c_idx = inp
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_blk) * scale  # [b,hk,g,s,C]
         kpos = c_idx * C + jnp.arange(C)
-        live = kpos[None, :] < s  # pad keys masked
-        if causal:  # same-block diagonal: local positions align
+        live = kpos[None, :] < sk_len  # pad keys masked
+        if causal:
             live = jnp.logical_and(live, kpos[None, :] <= qpos[:, None])
         scores = jnp.where(live[None, None, None], scores, NEG_INF)
         blk_max = jnp.max(scores, axis=-1, keepdims=True)
@@ -143,6 +156,110 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     return out.astype(q.dtype)
 
 
+def _zigzag_perms(P: int):
+    """Half-chunk re-layout permutations.  Global half-chunks 0..2P-1 live
+    contiguously (rank r holds 2r, 2r+1); zigzag wants rank r to hold
+    (r, 2P-1-r).  Two ppermutes do it: the lo-perm routes chunk 2r, the
+    hi-perm routes chunk 2r+1, each to the rank that owns it in zigzag."""
+    def dest(c: int) -> int:
+        return c if c < P else 2 * P - 1 - c
+
+    perm_lo = [(r, dest(2 * r)) for r in range(P)]
+    perm_hi = [(r, dest(2 * r + 1)) for r in range(P)]
+    return perm_lo, perm_hi
+
+
+def _ring_attention_zigzag(q, k, v, axis_name: str,
+                           softmax_scale: Optional[float] = None):
+    """Causal ring with the ZIGZAG layout (VERDICT r4 #3: stop paying wire and
+    wall-clock for skipped causal steps).
+
+    v2's cond-skip saved AGGREGATE FLOPs but not wall-clock: with contiguous
+    blocks, rank P-1 computes at every step, so the ring's critical path is
+    still P full block-pairs.  Zigzag re-layouts each rank to hold global
+    half-chunks (r, 2P-1-r): at every rotation step each rank finds exactly
+    ONE causally-live half-chunk pairing per received block — either its full
+    local queries against the received low half (src < my) or its high
+    queries against the full received block (src > my) — so every rank does
+    the same s x s/2 work each step and causal wall-clock is ~half of the
+    non-causal ring.  Comm per step is unchanged (the full local KV rotates
+    once forward, as in v2); re-layout costs 3 half-chunk ppermute pairs in
+    (q, k, v) plus one inverse for the output — amortized over P-1 steps.
+
+    Requires even local seq; callers fall back to the v2 cond-skip path
+    otherwise."""
+    P = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s, hq, d = q.shape
+    half = s // 2
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
+    perm_lo, perm_hi = _zigzag_perms(P)
+    even = (my % 2) == 0
+
+    def relayout(x):
+        a = lax.ppermute(x[:, :half], axis_name, perm_lo)
+        c = lax.ppermute(x[:, half:], axis_name, perm_hi)
+        # chunk parity: even ranks get their zigzag-lo via the lo-perm,
+        # odd ranks via the hi-perm (see _zigzag_perms wiring)
+        return jnp.where(even, a, c), jnp.where(even, c, a)
+
+    q_lo, q_hi = relayout(q)
+    k_lo, k_hi = relayout(k)
+    v_lo, v_hi = relayout(v)
+    qz = jnp.concatenate([q_lo, q_hi], axis=1)
+
+    # ---- step 0 (diagonal): q_lo sees its own chunk causally; q_hi sees the
+    # low chunk fully + its own chunk causally — one offset-causal call
+    o1, l1 = _block_attention(q_lo, k_lo, v_lo, True, scale)
+    k_cur = jnp.concatenate([k_lo, k_hi], axis=1)
+    v_cur = jnp.concatenate([v_lo, v_hi], axis=1)
+    o2, l2 = _block_attention(q_hi, k_cur, v_cur, True, scale)  # offset = half
+    acc = jnp.concatenate([o1, o2], axis=1)
+    den = jnp.ones((b, s, hq, 1), jnp.float32)
+    m_run = jnp.concatenate([l1, l2], axis=1)
+
+    perm = [(r, (r + 1) % P) for r in range(P)]
+    zeros_lo = jnp.zeros((b, half, hq, d), jnp.float32)
+    ninf_lo = jnp.full((b, half, hq, 1), NEG_INF, jnp.float32)
+
+    for step in range(1, P):
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        src = (my - step) % P  # zigzag-lo chunk id of the received block
+
+        def low_branch(kb, vb):
+            # received low chunk (global id src < my): visible to ALL local
+            # queries (q_lo chunk my > src, q_hi chunk 2P-1-my > src)
+            o, l = _block_attention(qz, kb[:, :half], vb[:, :half], False, scale)
+            return o, l
+
+        def high_branch(kb, vb):
+            # src > my: only q_hi (chunk 2P-1-my) sees the received block —
+            # and it sees BOTH halves (src < P <= 2P-1-my, and
+            # 2P-1-src < 2P-1-my); q_lo rows stay empty (lse = -inf)
+            o, l = _block_attention(q_hi, kb, vb, False, scale)
+            return (jnp.concatenate([zeros_lo, o], axis=1),
+                    jnp.concatenate([ninf_lo, l], axis=1))
+
+        o_blk, lse_blk = lax.cond(src < my, low_branch, high_branch, k_cur, v_cur)
+        m_new = jnp.maximum(m_run, lse_blk)
+        w_old = jnp.exp(m_run - m_new)
+        w_blk = jnp.exp(lse_blk - m_new)
+        acc = acc * w_old + o_blk * w_blk
+        den = den * w_old + w_blk
+        m_run = m_new
+
+    out = (acc / jnp.where(den == 0.0, 1.0, den)).astype(q.dtype)
+    # ---- inverse re-layout: zigzag (my, 2P-1-my) back to contiguous (2r, 2r+1)
+    inv_lo = [(d_, s_) for (s_, d_) in perm_lo]
+    inv_hi = [(d_, s_) for (s_, d_) in perm_hi]
+    z_lo, z_hi = out[:, :half], out[:, half:]
+    # a rank's zigzag-lo returns via the inverse of whichever perm delivered it
+    a = lax.ppermute(jnp.where(even, z_lo, z_hi), axis_name, inv_lo)
+    c = lax.ppermute(jnp.where(even, z_hi, z_lo), axis_name, inv_hi)
+    return jnp.concatenate([a, c], axis=1)
+
+
 def ring_attention(local_attn_unused: Optional[Callable] = None,
                    topo: Optional[MeshTopology] = None,
                    seq_axis: str = SEQUENCE_AXIS):
@@ -158,8 +275,16 @@ def ring_attention(local_attn_unused: Optional[Callable] = None,
         P = t.axis_size(seq_axis)
         if P <= 1 or mask is not None:
             return sdpa(q, k, v, causal=causal, mask=mask, **kw)
-        body = functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal,
-                                 softmax_scale=kw.get("softmax_scale"))
+        s_local = q.shape[1] // P
+        if causal and s_local % 2 == 0:
+            # zigzag: balanced causal schedule — every rank computes the same
+            # s x s/2 area per step, halving causal ring wall-clock
+            body = functools.partial(_ring_attention_zigzag, axis_name=seq_axis,
+                                     softmax_scale=kw.get("softmax_scale"))
+        else:
+            body = functools.partial(_ring_attention_local, axis_name=seq_axis,
+                                     causal=causal,
+                                     softmax_scale=kw.get("softmax_scale"))
         spec = PartitionSpec(None, seq_axis, None, None)
         return jax.shard_map(body, mesh=t.mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)

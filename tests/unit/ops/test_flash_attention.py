@@ -130,3 +130,65 @@ def test_msa_row_attention_block():
     out = msa_row_attention_with_pair_bias(msa, pair, params, num_heads=H)
     assert out.shape == (rows, S, C)
     assert np.isfinite(np.asarray(out)).all()
+
+
+# ----------------------------------------------------- flash with logsumexp
+def _ref_out_lse(q, k, v, causal, scale):
+    """sdpa-equivalent reference computing (out, lse) densely."""
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    if causal:
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        mask = jnp.arange(sk)[None, :] <= qpos
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B,H,Sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    return out, lse
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_with_lse_forward(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 1, 64, 4, 4, 32)
+    out, lse = flash.flash_attention_with_lse(q, k, v, causal=causal,
+                                              block_q=32, block_k=32)
+    ref_o, ref_l = _ref_out_lse(q, k, v, causal, 1.0 / np.sqrt(32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_l), atol=2e-5)
+
+
+def test_with_lse_offset_causal():
+    """sq != sk: queries sit at the end of the key frame (zigzag diagonal)."""
+    q, _, _ = _rand_qkv(jax.random.PRNGKey(1), 1, 16, 4, 4, 32)
+    _, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 32, 4, 4, 32)
+    out, lse = flash.flash_attention_with_lse(q, k, v, causal=True,
+                                              block_q=16, block_k=16)
+    ref_o, ref_l = _ref_out_lse(q, k, v, True, 1.0 / np.sqrt(32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_l), atol=2e-5)
+
+
+@pytest.mark.slow
+def test_with_lse_grads_include_lse_cotangent():
+    """Gradients flow through BOTH outputs — the lse cotangent folds into the
+    backward delta term (ring merges weight blocks by exp(lse - m), so a
+    wrong lse-grad would corrupt every causal ring backward)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 32, 4, 2, 16)
+    scale = 1.0 / np.sqrt(16)
+
+    def loss_kernel(q, k, v):
+        o, l = flash.flash_attention_with_lse(q, k, v, causal=True,
+                                              block_q=16, block_k=16)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))  # both outputs used
+
+    def loss_ref(q, k, v):
+        o, l = _ref_out_lse(q, k, v, True, scale)
+        return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)

@@ -243,3 +243,39 @@ def test_ring_causal_skips_masked_steps_runtime():
 
     t_causal, t_full = timed(True), timed(False)
     assert t_causal < 0.9 * t_full, (t_causal, t_full)
+
+
+def test_ring_causal_odd_local_seq_falls_back(seq_topo):
+    """Odd local seq can't split into zigzag halves — the v2 cond-skip path
+    must serve those shapes (and stay numerically correct)."""
+    from deepspeed_tpu.sequence.ring import ring_attention
+    q, k, v = _qkv(b=1, s=56, h=4, d=16, seed=11)  # 56/8 = 7 tokens/rank, odd
+    expected = np.asarray(sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    attn = ring_attention(topo=seq_topo)
+    seq_sharding = NamedSharding(seq_topo.mesh, P(None, "sequence"))
+    out = np.asarray(jax.jit(lambda a, b_, c: attn(a, b_, c, causal=True))(
+        jax.device_put(q, seq_sharding), jax.device_put(k, seq_sharding),
+        jax.device_put(v, seq_sharding)))
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_zigzag_equals_v2_schedule(seq_topo):
+    """The zigzag causal schedule and the v2 cond-skip schedule compute the
+    same attention (they differ only in layout/balance)."""
+    import functools
+
+    from deepspeed_tpu.sequence.ring import (_ring_attention_local,
+                                             _ring_attention_zigzag)
+    q, k, v = _qkv(b=2, s=64, h=4, d=16, seed=12)
+    seq_sharding = NamedSharding(seq_topo.mesh, P(None, "sequence"))
+    args = [jax.device_put(x, seq_sharding) for x in (q, k, v)]
+    spec = P(None, "sequence", None, None)
+
+    def run(body):
+        return np.asarray(jax.jit(jax.shard_map(
+            body, mesh=seq_topo.mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False))(*args))
+
+    zig = run(functools.partial(_ring_attention_zigzag, axis_name="sequence"))
+    v2 = run(functools.partial(_ring_attention_local, axis_name="sequence", causal=True))
+    np.testing.assert_allclose(zig, v2, rtol=1e-4, atol=1e-5)
