@@ -112,8 +112,14 @@ def forward(config: LlamaConfig, params, input_ids, attention_fn=None, rng=None)
     x = params["embed"][input_ids]  # keep embed dtype (engine casts params)
     layer = _layer_fn(config, cos, sin, attention_fn)
     if config.remat:
-        from ..runtime.activation_checkpointing import resolve_policy
-        layer = jax.checkpoint(layer, policy=resolve_policy(config.remat_policy))
+        if config.remat_policy in ("offload_inputs", "cpu_checkpointing"):
+            # real host-offloaded checkpointing (the policy-based offload
+            # silently degrades to recompute — activation_checkpointing.py)
+            from ..runtime.activation_checkpointing import offload_checkpoint
+            layer = offload_checkpoint(layer)
+        else:
+            from ..runtime.activation_checkpointing import resolve_policy
+            layer = jax.checkpoint(layer, policy=resolve_policy(config.remat_policy))
     ltd = configured_ltd()
     if ltd is not None and rng is not None:
         x = random_ltd_scan(layer, x, params["layers"], rng, int(ltd["keep"]))

@@ -44,21 +44,26 @@ def layer_norm(x, weight, bias, eps=1e-5):
 
 # ----------------------------------------------------------------- rotary
 def rotary_tables(head_dim: int, max_seq: int, theta: float = 10000.0):
+    """Returns NUMPY tables (config-static constants): layer closures that
+    capture them stay trace-free, which custom_vjp wrappers
+    (activation_checkpointing.offload_checkpoint) require — a jnp constant
+    created during tracing is a tracer, and custom_vjp can't close over
+    tracers.  apply_rotary converts at use."""
     inv_freq = 1.0 / (theta**(np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
     t = np.arange(max_seq, dtype=np.float32)
     freqs = np.outer(t, inv_freq)  # [S, D/2]
-    return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+    return np.cos(freqs), np.sin(freqs)
 
 
 def apply_rotary(x, cos, sin, positions=None):
-    """x: [B, S, H, D]. cos/sin: [maxS, D/2]."""
+    """x: [B, S, H, D]. cos/sin: [maxS, D/2] (numpy or jnp)."""
     seq = x.shape[1]
     if positions is None:
-        c = cos[:seq][None, :, None, :]
-        s = sin[:seq][None, :, None, :]
+        c = jnp.asarray(cos[:seq])[None, :, None, :]
+        s = jnp.asarray(sin[:seq])[None, :, None, :]
     else:
-        c = cos[positions][:, :, None, :]
-        s = sin[positions][:, :, None, :]
+        c = jnp.asarray(cos)[positions][:, :, None, :]
+        s = jnp.asarray(sin)[positions][:, :, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
     c = c.astype(x.dtype)
     s = s.astype(x.dtype)
@@ -213,7 +218,9 @@ def random_ltd_scan(layer, x, stacked_params, rng, keep: int):
         key, sub = jax.random.split(key)
         idx = sample_token_indices(sub, S, keep)
         kept = gather_tokens(h, idx)
-        y, _ = layer(kept, lp, positions=idx[None, :])  # [1, K]: original rotary positions
+        # positions passed POSITIONALLY: custom_vjp-wrapped layers
+        # (offload_checkpoint) accept no kwargs
+        y, _ = layer(kept, lp, idx[None, :])  # [1, K]: original rotary positions
         return (scatter_tokens(h, y, idx), key), None
 
     (x, _), _ = jax.lax.scan(mid_body, (x, rng), mids)

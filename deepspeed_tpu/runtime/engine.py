@@ -153,10 +153,12 @@ class Engine:
             # engine-cannot-apply pattern as the hpZ/qwZ knobs
             log_dist(
                 f"activation_checkpointing requests policy="
-                f"{'offload_residuals (cpu_checkpointing)' if act_cfg.cpu_checkpointing else act_cfg.policy}: "
-                f"apply it in the model config (e.g. LlamaConfig.remat_policy) or via "
-                f"runtime.activation_checkpointing.policy_from_config — the engine cannot "
-                f"rewrite remat inside an opaque loss_fn", ranks=[0])
+                f"{'cpu_checkpointing (host-offloaded inputs)' if act_cfg.cpu_checkpointing else act_cfg.policy}: "
+                f"apply it in the model config (LlamaConfig.remat_policy="
+                f"{'offload_inputs' if act_cfg.cpu_checkpointing else act_cfg.policy!r}, "
+                f"or runtime.activation_checkpointing.offload_checkpoint for custom "
+                f"stacks) — the engine cannot rewrite remat inside an opaque loss_fn",
+                ranks=[0])
         off = config.zero_optimization.offload_optimizer
         self.offload_device = off.device if (off is not None and off.device != "none") else None
         off_p = config.zero_optimization.offload_param

@@ -106,6 +106,16 @@ def _block_attention(q, k, v, causal: bool, scale: float, chunk: int = 1024):
     return o, lse
 
 
+def _merge_block(acc, den, m_run, o, lse):
+    """Numerically-stable online-softmax merge of one (normalized out, lse)
+    block pair into the running accumulators — shared by every ring schedule
+    so the NEG_INF/underflow handling lives in exactly one place."""
+    m_new = jnp.maximum(m_run, lse)
+    w_old = jnp.exp(m_run - m_new)
+    w_blk = jnp.exp(lse - m_new)
+    return acc * w_old + o * w_blk, den * w_old + w_blk, m_new
+
+
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                           softmax_scale: Optional[float] = None):
     """Runs INSIDE shard_map. q/k/v: local [B, s, H, D] shards (kv heads may be
@@ -128,10 +138,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
         def merge(carry, k_blk, v_blk, blk_causal):
             acc, den, m_run = carry
             o, lse = _block_attention(q, k_blk, v_blk, blk_causal, scale)
-            m_new = jnp.maximum(m_run, lse)
-            w_old = jnp.exp(m_run - m_new)
-            w_blk = jnp.exp(lse - m_new)
-            return (acc * w_old + o * w_blk, den * w_old + w_blk, m_new)
+            return _merge_block(acc, den, m_run, o, lse)
 
         if not causal:
             acc, den, m_run = merge((acc, den, m_run), k_cur, v_cur, False)
@@ -242,12 +249,7 @@ def _ring_attention_zigzag(q, k, v, axis_name: str,
                     jnp.concatenate([ninf_lo, l], axis=1))
 
         o_blk, lse_blk = lax.cond(src < my, low_branch, high_branch, k_cur, v_cur)
-        m_new = jnp.maximum(m_run, lse_blk)
-        w_old = jnp.exp(m_run - m_new)
-        w_blk = jnp.exp(lse_blk - m_new)
-        acc = acc * w_old + o_blk * w_blk
-        den = den * w_old + w_blk
-        m_run = m_new
+        acc, den, m_run = _merge_block(acc, den, m_run, o_blk, lse_blk)
 
     out = (acc / jnp.where(den == 0.0, 1.0, den)).astype(q.dtype)
     # ---- inverse re-layout: zigzag (my, 2P-1-my) back to contiguous (2r, 2r+1)
