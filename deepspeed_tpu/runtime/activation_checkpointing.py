@@ -129,9 +129,23 @@ def offload_checkpoint(layer_fn):
         return layer_fn(x, params, *rest)
 
     def fwd(x, params, *rest):
+        _guard_rest(rest)
         out = layer_fn(x, params, *rest)
         x_host = jax.device_put(x, jax.memory.Space.Host)
         return out, (x_host, params, rest)
+
+    def _guard_rest(rest):
+        # *rest gets None cotangents in bwd — a differentiable float extra
+        # (per-layer scale, bias, tables) would silently train with zero
+        # gradient, so refuse it loudly; int extras (positions) are fine
+        import numpy as np
+        for leaf in jax.tree_util.tree_leaves(rest):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and np.issubdtype(dt, np.inexact):
+                raise TypeError(
+                    "offload_checkpoint: extra args (*rest) receive no gradient; "
+                    "found a float-dtype extra — pass differentiable values "
+                    "through `params` instead")
 
     def bwd(res, g):
         x_host, params, rest = res

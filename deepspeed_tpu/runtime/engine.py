@@ -157,7 +157,10 @@ class Engine:
                 f"apply it in the model config (LlamaConfig.remat_policy="
                 f"{'offload_inputs' if act_cfg.cpu_checkpointing else act_cfg.policy!r}, "
                 f"or runtime.activation_checkpointing.offload_checkpoint for custom "
-                f"stacks) — the engine cannot rewrite remat inside an opaque loss_fn",
+                f"stacks) — the engine cannot rewrite remat inside an opaque loss_fn. "
+                f"NOTE: host-offload remat is a PER-DEVICE lever (single chip or "
+                f"inside shard_map); multi-device GSPMD jit rejects the placement "
+                f"annotation (activation_checkpointing.py composition status)",
                 ranks=[0])
         off = config.zero_optimization.offload_optimizer
         self.offload_device = off.device if (off is not None and off.device != "none") else None

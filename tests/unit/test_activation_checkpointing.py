@@ -131,6 +131,28 @@ def test_llama_offload_inputs_policy_trains():
         return jax.jit(lambda p: fn(p, batch, jax.random.PRNGKey(1)))(params)
 
     np.testing.assert_allclose(float(loss(off_cfg)), float(loss(base)), rtol=1e-5)
-    g = jax.jit(jax.grad(lambda p: llama.make_loss_fn(off_cfg)(p, batch, None)))(params)
-    assert np.isfinite(float(jax.tree_util.tree_reduce(
-        lambda a, b: a + jnp.sum(jnp.abs(b)), g, 0.0)))
+    # gradients too — a wrong bwd cotangent would keep the forward identical
+    g_off = jax.jit(jax.grad(lambda p: llama.make_loss_fn(off_cfg)(p, batch, None)))(params)
+    g_base = jax.jit(jax.grad(lambda p: llama.make_loss_fn(base)(p, batch, None)))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=2e-4, atol=1e-6),
+        g_off, g_base)
+
+
+def test_offload_checkpoint_rejects_float_extras():
+    """Float-dtype *rest extras would silently get zero gradient — the wrapper
+    must refuse them (differentiable values belong in params)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from deepspeed_tpu.runtime.activation_checkpointing import offload_checkpoint
+
+    def layer(x, p, scale):
+        return jnp.tanh(x @ p) * scale, None
+
+    wrapped = offload_checkpoint(layer)
+    x = jnp.ones((2, 4)); p = jnp.eye(4)
+    with _pytest.raises(TypeError, match="no gradient"):
+        jax.grad(lambda p_: jnp.sum(wrapped(x, p_, jnp.float32(2.0))[0]))(p)
