@@ -416,11 +416,21 @@ def measure_ring(on_tpu: bool):
 
 
 def _measure_h2d_mbps() -> float:
-    """Host->device link bandwidth (64 MB probe).  Real TPU hosts: PCIe,
-    GB/s.  The axon dev tunnel: a ~15-30 MB/s network relay — the binding
-    constraint for layer streaming, reported so the artifact explains the
-    step time."""
+    """Host->device link bandwidth.  Real TPU hosts: PCIe, GB/s.  The axon
+    dev tunnel: a ~15-30 MB/s network relay — the binding constraint for
+    layer streaming, reported so the artifact explains the step time.
+
+    A 1 MB pre-probe runs first: when the relay has degraded to ~KB/s (it
+    does after long sessions), committing to the full 64 MB probe would hang
+    the bench for the exact failure the caller's skip guard exists for."""
     import jax
+    small = np.random.default_rng(0).random(1 << 18, np.float32)  # 1 MB
+    t0 = time.perf_counter()
+    x = jax.device_put(small)
+    float(x[0])
+    dt_small = time.perf_counter() - t0
+    if dt_small > 2.0:  # < 0.5 MB/s: report the tiny estimate, skip the 64 MB
+        return small.nbytes / dt_small / 1e6
     a = np.random.default_rng(0).random(16 * (1 << 20), np.float32)  # 64 MB
     x = jax.device_put(a)
     float(x[0])
@@ -464,8 +474,15 @@ def measure_training_infinity(on_tpu: bool, budget_s: float | None = None):
     from deepspeed_tpu.models.transformer import cross_entropy_loss, rms_norm, rotary_tables
 
     h2d_mbps = _measure_h2d_mbps()
+    if h2d_mbps < 4.0:
+        # the relay sometimes degrades to ~KB/s after long sessions; a
+        # streaming leg would hang past every budget — skip with the offline
+        # full-depth proof instead
+        return {"infinity": f"skipped_degraded_link ({h2d_mbps:.1f} MB/s)",
+                **_infinity_offline()}
     if budget_s is None:
         budget_s = float(os.environ.get("BENCH_INFINITY_BUDGET_S", "120"))
+    leg_deadline = time.perf_counter() + budget_s * 1.5  # hard stop
     # shape ladder: (hidden, intermediate, heads, kv_heads); bf16 bytes/layer =
     # 2 * (4*D*D + 3*D*F).  Pick the widest whose 2-layer proof (stream each
     # layer up twice per step, 2 steps + warm + init slack) fits the budget.
@@ -558,12 +575,21 @@ def measure_training_infinity(on_tpu: bool, budget_s: float | None = None):
         tokens = rng.integers(0, cfg.vocab_size, (micro, seq))
         batch = {"x": tokens, "y": np.roll(tokens, -1, axis=1)}
         t0 = time.perf_counter()
-        engine.train_batch(batch)  # warm (compiles the per-layer fwd/bwd jits)
+        m = engine.train_batch(batch)  # warm (compiles the per-layer fwd/bwd jits)
+        float(m.loss)  # sync INSIDE the window (only a value fetch drains the relay)
         warm_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        m = engine.train_batch(batch)
-        step_s = time.perf_counter() - t0
-        loss = float(m.loss)
+        fallback = False
+        if time.perf_counter() > leg_deadline:
+            # link slower than probed: report the warm step as the measurement
+            # rather than risking the whole artifact on a second pass
+            loss = float(m.loss)
+            step_s = warm_s
+            fallback = True
+        else:
+            t0 = time.perf_counter()
+            m = engine.train_batch(batch)
+            step_s = time.perf_counter() - t0
+            loss = float(m.loss)
         if not np.isfinite(loss):
             return {"infinity": f"nonfinite loss {loss}"}
         out = {
@@ -576,6 +602,8 @@ def measure_training_infinity(on_tpu: bool, budget_s: float | None = None):
             "infinity_init_s": round(init_s, 1),
             "infinity_loss": round(loss, 3),
             "infinity_placement": "params:nvme moments:cpu",
+            **({"infinity_note": "deadline fallback: step_s includes compile (warm step)"}
+               if fallback else {}),
             "infinity_h2d_link_mbps": round(h2d_mbps, 1),
             "infinity_vs_hbm_wall": round(n_params / 1e9 / 1.4026, 2),
         }
@@ -743,6 +771,8 @@ def measure_fsdp_virtual(timeout_s: int = 280):
         "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
         "import sys; sys.path.insert(0, {repo!r});"
         "import jax; jax.config.update('jax_platforms','cpu');"
+        "jax.config.update('jax_compilation_cache_dir','/tmp/dstpu_jax_cache');"
+        "jax.config.update('jax_persistent_cache_min_compile_time_secs',1.0);"
         "import time, numpy as np, deepspeed_tpu;"
         "from deepspeed_tpu.models import llama;"
         "from deepspeed_tpu.parallel import MeshTopology;"
@@ -847,17 +877,19 @@ def main():
                                 "BENCH_PARTIAL.json")
     for key, est, thunk in legs:
         if key == "fsdp":
+            # the subprocess rides the persistent compile cache (~10s warm);
+            # only skip when the budget is truly exhausted
             if not on_tpu:
                 res = {"fsdp_virtual8": "skipped_on_cpu"}
-            elif _remaining() > 75:
-                res = _leg(key, measure_fsdp_virtual, int(min(_remaining() - 40, 150)))
+            elif _remaining() > 40:
+                res = _leg(key, measure_fsdp_virtual, int(min(_remaining() - 25, 150)))
             else:
                 res = {"fsdp_virtual8": "skipped_budget"}
         elif key == "infinity":
             if _remaining() > 70:
                 res = _leg(key, measure_training_infinity, on_tpu,
-                           float(min(_remaining() - 25,
-                                     float(os.environ.get("BENCH_INFINITY_BUDGET_S", "120")))))
+                           float(min(_remaining() - 45,
+                                     float(os.environ.get("BENCH_INFINITY_BUDGET_S", "110")))))
             else:
                 res = _leg(key, lambda: {"infinity": "skipped_budget", **_infinity_offline()})
         elif key != "train" and key != "lanes" and _remaining() < est:

@@ -140,6 +140,8 @@ def offload_checkpoint(layer_fn):
         # gradient, so refuse it loudly; int extras (positions) are fine
         import numpy as np
         for leaf in jax.tree_util.tree_leaves(rest):
+            if isinstance(leaf, np.ndarray):
+                continue  # plain numpy constants can never carry gradients
             dt = getattr(leaf, "dtype", None)
             if dt is not None and np.issubdtype(dt, np.inexact):
                 raise TypeError(
