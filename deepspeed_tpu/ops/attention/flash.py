@@ -84,8 +84,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc, *, scale,
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
-    block_q = min(block_q, max(8, sq))
-    block_k = min(block_k, max(8, sk))
+    # clamp to the 8-ALIGNED sequence length: a raw-S block (e.g. 900) has a
+    # non-sublane-multiple second-minor dim that Mosaic may reject
+    block_q = min(block_q, max(8, int(np.ceil(sq / 8)) * 8))
+    block_k = min(block_k, max(8, int(np.ceil(sk / 8)) * 8))
     sq_p = int(np.ceil(sq / block_q)) * block_q
     sk_p = int(np.ceil(sk / block_k)) * block_k
     qt = jnp.pad(q.transpose(0, 2, 1, 3), ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
@@ -219,8 +221,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g, g_lse=None):
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     group = hq // hk
-    block_q = min(block_q, max(8, sq))
-    block_k = min(block_k, max(8, sk))
+    block_q = min(block_q, max(8, int(np.ceil(sq / 8)) * 8))  # 8-aligned clamp
+    block_k = min(block_k, max(8, int(np.ceil(sk / 8)) * 8))
     sq_p = int(np.ceil(sq / block_q)) * block_q
     sk_p = int(np.ceil(sk / block_k)) * block_k
 
@@ -342,7 +344,7 @@ _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              softmax_scale: Optional[float] = None,
-                             block_q: int = 512, block_k: int = 512):
+                             block_q: int = 1024, block_k: int = 1024):
     """Flash attention returning (out [B,Sq,H,D], lse [B,H,Sq]) — the form a
     blockwise/ring outer loop needs to merge per-block results (VERDICT r4 #3:
     'expose logsumexp and let the ring dispatch to it').  Differentiable in
@@ -371,7 +373,11 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
 
 def flash_attention(q, k, v, causal: bool = True, mask=None,
                     softmax_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: int = 1024, block_k: int = 1024):
+    # default 1024x1024 blocks: r5 sweep at the training shape (6 x 2048 x
+    # 18h GQA, d=128) measured fwd 10.7 vs 11.6 ms and fwd+bwd 22.8 vs 25.4
+    # ms against 512x512 — ~10%; 2048 blocks exceed VMEM.  Shorter sequences
+    # clamp the block to the 8-aligned sequence length.
     """Drop-in for models.transformer.sdpa: q/k/v [B, S, H, D], GQA allowed.
 
     Dense ``mask`` forces the XLA fallback (the blocked kernel handles only the
