@@ -53,9 +53,6 @@ def _on_term(signum, frame):  # noqa: ARG001 — signal signature
     os._exit(0 if _LATEST_LINE is not None else 124)
 
 
-signal.signal(signal.SIGTERM, _on_term)
-signal.signal(signal.SIGINT, _on_term)
-
 # bf16 peak FLOPs by TPU generation (per chip)
 PEAK_FLOPS = {
     "v5e": 197e12,
@@ -848,6 +845,12 @@ def _artifact(extra: dict) -> str:
 
 def main():
     global _LATEST_LINE
+    # FIRST statements: the backstop must cover the slow `import jax` below
+    # (a driver timeout landing mid-import must still leave the documented
+    # signal behavior).  Registered here, not at module import, so tests that
+    # import this module keep their process-wide signal handling.
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
     import jax
 
     # persistent compilation cache: through the axon relay a trivial jit
