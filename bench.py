@@ -195,10 +195,11 @@ def measure_training_big(on_tpu: bool):
     """Big-model leg: the largest Llama the chip fits with blockwise 8-bit
     optimizer states (ops/adam/adam8bit.py) — fp32 master + int8 moments is
     ~6 bytes/param steady vs 14 with fp32 moments, which moves the one-chip
-    wall from 770M to 1.4B params.  Reported config (sweep r3): hidden 2560 x
-    16 layers GQA(20h/4kv), 1.26B params, micro 2 -> 0.455 MFU (frontier:
-    L=17/1.33B 0.452; L=18/1.40B fits only at micro 1, 0.357; L=18 micro 2
-    OOMs).  Skipped off-TPU (minutes of CPU compile for no signal)."""
+    wall from 770M to 1.4B params.  Reported config: hidden 2560 x 16 layers
+    GQA(20h/4kv), 1.26B params, micro 2 (r5 with 1024-block flash: ~0.48
+    MFU; frontier L=18/1.40B fits only at micro 1, 0.3688 — see the
+    provenance-marked bigmodel_max_fit record below).  Skipped off-TPU
+    (minutes of CPU compile for no signal)."""
     if not on_tpu:
         return {"bigmodel": "skipped_on_cpu"}
     import jax
@@ -243,9 +244,12 @@ def measure_training_big(on_tpu: bool):
         "bigmodel_params_m": round(llama.num_params(cfg) / 1e6, 1),
         "bigmodel_tok_s_per_chip": round(tokens_per_sec / n_chips, 1),
         "bigmodel_optimizer": "fused_adam8bit",
-        # sweep claim from r3 (L=18 trains at micro 1, MFU 0.357), not measured
-        # by this run — keyed as a claim per ADVICE r3 #4
-        "bigmodel_claimed_max_fit_params_m": 1402.6,
+        # provenance-marked (ADVICE r3 #4): the frontier is NOT measured by
+        # this run — values from the offline r5 sweep
+        "bigmodel_max_fit": {"params_m": 1402.6, "mfu": 0.3688,
+                             "source": "offline sweep r5: L=18 micro1 trains, "
+                                       "micro2 exceeds the envelope; not "
+                                       "measured by this run"},
     }
 
 
