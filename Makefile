@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,3 +18,8 @@ fast_then_slow:
 
 bench:
 	$(PY) bench.py
+
+# 3-step CPU train loop with telemetry enabled; asserts 3 well-formed JSONL
+# records (loss/step_time/throughput/mfu/hbm) + jax.profiler trace files
+telemetry-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --telemetry-smoke

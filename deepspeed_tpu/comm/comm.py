@@ -251,6 +251,12 @@ def log_summary(show_straggler=False):
     return get_comms_logger().log_summary(show_straggler=show_straggler)
 
 
+def monitor_events(step: int = 0):
+    """Comms-logger summary as monitor ``(tag, value, step)`` events, for the
+    telemetry collector's event stream (empty when nothing was profiled)."""
+    return get_comms_logger().as_events(step)
+
+
 def configure(comms_config=None):
     if comms_config is not None:
         get_comms_logger().configure(comms_config)

@@ -55,16 +55,22 @@ class InferenceEngineV2:
                  num_blocks: int = 512, block_size: int = 16,
                  max_blocks_per_seq: int = 64, token_budget: int = 256,
                  max_seqs_per_step: int = 32,
-                 topology: Optional[MeshTopology] = None):
+                 topology: Optional[MeshTopology] = None,
+                 telemetry=None):
         self.config = load_inference_config(config)
         self.model = model_module
         self.model_config = model_config
         self.dtype = _DTYPES[self.config.dtype]
         self.block_size = block_size
         self.manager = RaggedStateManager(num_blocks, block_size, max_blocks_per_seq)
-        self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step)
+        # telemetry: a monitor.TelemetryCollector; the scheduler emits its
+        # gauges through it and step() adds serving rates (ISSUE 1 tentpole)
+        self.telemetry = telemetry
+        self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step,
+                                            telemetry=telemetry)
         self.topology = topology
         self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
+        self._warn_truncated_nucleus()
         params = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.dtype), params)
         kv = model_module.init_paged_cache(model_config, num_blocks, block_size, dtype=self.dtype)
         if self.tp > 1:
@@ -90,6 +96,24 @@ class InferenceEngineV2:
         self.max_blocks_per_seq = max_blocks_per_seq
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{block_size} "
                  f"budget={token_budget} dtype={self.config.dtype} tp={self.tp}", ranks=[0])
+
+    def _warn_truncated_nucleus(self):
+        """One-time runtime notice when TP candidate-set sampling approximates
+        top-p (ADVICE r5): with ``top_p < 1`` each shard contributes k' =
+        max(top_k, 64) candidates, so tail mass outside the k'*tp candidate
+        set is redistributed unless k'*tp covers the vocabulary."""
+        vocab = getattr(self.model_config, "vocab_size", None)
+        if self.tp <= 1 or vocab is None or not self.config.top_p < 1.0:
+            return
+        kc = max(int(self.config.top_k) if self.config.top_k else 0, 64)
+        if kc * self.tp < int(vocab):
+            from ...utils.logging import warning_once
+            warning_once(
+                f"InferenceEngineV2: top_p={self.config.top_p} with tp={self.tp} uses the "
+                f"truncated-nucleus approximation — sampling sees {kc}*{self.tp}="
+                f"{kc * self.tp} candidates of V={int(vocab)}, so nucleus mass outside the "
+                f"per-shard top-{kc} sets is redistributed; raise top_k to widen coverage "
+                f"if exact top-p sampling matters")
 
     def _shard_mapped(self, inner, out_specs):
         """Wrap a (params, kv, *replicated) forward for TP: replicated
@@ -179,7 +203,25 @@ class InferenceEngineV2:
                 tok = int(toks[i])
                 seq.tokens.append(tok)
                 out[c.uid] = tok
+        self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
+
+    def _emit_serving_gauges(self, tokens_run: int) -> None:
+        """Serving rates on top of the scheduler's per-step gauges: requests/s
+        (retired-sequence rate) and tokens/s through the ragged forward."""
+        if self.telemetry is None:
+            return
+        gauges = {"live_seqs": float(len(self.manager.live_uids()))}
+        rps = self.telemetry.rate("v2_completed_requests",
+                                  float(self.manager.completed_requests))
+        if rps is not None:
+            gauges["requests_per_sec"] = rps
+        self._tokens_run_total = getattr(self, "_tokens_run_total", 0) + tokens_run
+        tps = self.telemetry.rate("v2_tokens_total", float(self._tokens_run_total))
+        if tps is not None:
+            gauges["tokens_per_sec"] = tps
+        self.telemetry.record_gauges(gauges, step=self.scheduler.steps,
+                                     prefix="Inference/Serving")
 
     def _compiled_step_pick(self, n: int, greedy: bool):
         key = ("pick", n, greedy, self.config.temperature, self.config.top_k,

@@ -39,6 +39,11 @@ class RaggedStateManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self.failures: Dict[int, str] = {}
+        # lifetime counters feeding the telemetry gauges (requests/sec is the
+        # collector-side rate over completed_requests)
+        self.total_requests = 0
+        self.completed_requests = 0
+        self.failed_requests = 0
 
     @property
     def trash_block(self) -> int:
@@ -49,6 +54,7 @@ class RaggedStateManager:
             raise ValueError(f"uid {uid} already tracked")
         seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens))
         self.seqs[uid] = seq
+        self.total_requests += 1
         return seq
 
     def ensure_blocks(self, seq: SequenceDescriptor, upto_tokens: int) -> None:
@@ -65,6 +71,7 @@ class RaggedStateManager:
 
     def fail(self, uid: int, reason: str) -> None:
         self.failures[uid] = reason
+        self.failed_requests += 1
         seq = self.seqs.get(uid)
         if seq is not None:
             seq.done = True
@@ -86,6 +93,14 @@ class RaggedStateManager:
     def retire(self, uid: int) -> None:
         seq = self.seqs.pop(uid)
         self.allocator.free(seq.blocks)
+        if uid not in self.failures:  # a flushed failure is not a completion
+            self.completed_requests += 1
 
     def live_uids(self) -> List[int]:
         return [uid for uid, s in self.seqs.items() if not s.done]
+
+    def kv_utilization(self) -> float:
+        """Fraction of the usable KV pool currently allocated (trash block
+        excluded) — the paged-attention memory-pressure gauge."""
+        usable = self.allocator.num_blocks - 1
+        return (usable - self.allocator.free_blocks) / max(usable, 1)

@@ -9,7 +9,7 @@ steps — "split"), and prompts co-run with decodes in one ragged batch
 """
 
 import dataclasses
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .ragged_manager import RaggedStateManager, SequenceDescriptor
 
@@ -22,9 +22,15 @@ class ScheduledChunk:
 
 class SplitFuseScheduler:
 
-    def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64):
+    def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64,
+                 telemetry=None):
         self.token_budget = token_budget
         self.max_seqs = max_seqs_per_step
+        # TelemetryCollector (monitor/telemetry.py); every schedule() emits
+        # the scheduler gauges through it when attached
+        self.telemetry = telemetry
+        self.steps = 0
+        self.last_gauges: Dict[str, float] = {}
 
     def schedule(self, manager: RaggedStateManager) -> List[ScheduledChunk]:
         """Pick this step's ragged batch. Decodes first (latency), then prompt
@@ -56,7 +62,28 @@ class SplitFuseScheduler:
                 continue
             chunks.append(ScheduledChunk(seq.uid, take))
             budget -= take
+        self._emit_gauges(manager, chunks, len(decoding), len(prefilling))
         return chunks
+
+    def _emit_gauges(self, manager: RaggedStateManager, chunks: List[ScheduledChunk],
+                     n_decoding: int, n_prefilling: int) -> None:
+        """Scheduler observability: queue depth, batch token occupancy, and
+        KV-block utilization per step, flowing through the shared telemetry
+        collector (the scheduler was a black box before — ISSUE 1)."""
+        scheduled_tokens = sum(c.n_tokens for c in chunks)
+        self.last_gauges = {
+            "queue_depth": float(n_decoding + n_prefilling),
+            "decode_seqs": float(n_decoding),
+            "prefill_seqs": float(n_prefilling),
+            "scheduled_seqs": float(len(chunks)),
+            "scheduled_tokens": float(scheduled_tokens),
+            "token_occupancy": scheduled_tokens / max(self.token_budget, 1),
+            "kv_block_utilization": manager.kv_utilization(),
+        }
+        self.steps += 1
+        if self.telemetry is not None:
+            self.telemetry.record_gauges(self.last_gauges, step=self.steps,
+                                         prefix="Inference/Scheduler")
 
     @staticmethod
     def _reserve(manager: RaggedStateManager, seq: SequenceDescriptor, n: int) -> bool:

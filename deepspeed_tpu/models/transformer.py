@@ -158,17 +158,30 @@ def configured_attention_engaged() -> bool:
 # steps (the reference pays the same recompile via its seqlen buckets).
 _CONFIGURED_LTD = {"state": None, "engaged": False}
 
+# Force-empty pin: scoped_random_ltd(fn, None) installs this sentinel rather
+# than None so INNER scopes can tell "an outer scope pinned LTD off" (eval)
+# apart from "no scope active".  Without it the engine's eval wrapper was dead
+# code: initialize() already wraps the loss_fn with the train LTD state, and
+# that inner wrapper re-installed the state right over eval's empty pin, so
+# eval traced WITH token dropping (ADVICE r5 medium).
+_LTD_FORCE_EMPTY = object()
+
 
 def scoped_random_ltd(loss_fn, ltd_state):
     """Pin ``ltd_state`` as the configured random-LTD while loss_fn traces
     (``None`` pins the scope EMPTY — how the engine's eval step keeps LTD
-    train-only).  Engagement is recorded on the state dict itself
-    (``ltd_state["engaged"]``), so each engine sees its own truth rather than
-    a process-global flag."""
+    train-only; the empty pin is AUTHORITATIVE over inner train wrappers).
+    Engagement is recorded on the state dict itself (``ltd_state["engaged"]``),
+    so each engine sees its own truth rather than a process-global flag."""
+    pin = _LTD_FORCE_EMPTY if ltd_state is None else ltd_state
 
     def scoped(*args, **kwargs):
         prev = _CONFIGURED_LTD["state"]
-        _CONFIGURED_LTD["state"] = ltd_state
+        if prev is _LTD_FORCE_EMPTY and ltd_state is not None:
+            # an outer scope pinned LTD off — the train wrapper must not
+            # re-engage it (eval measures the full model)
+            return loss_fn(*args, **kwargs)
+        _CONFIGURED_LTD["state"] = pin
         if ltd_state is not None:
             _CONFIGURED_LTD["engaged"] = False  # fresh trace, fresh verdict
         try:
@@ -180,7 +193,8 @@ def scoped_random_ltd(loss_fn, ltd_state):
 
 
 def configured_ltd():
-    return _CONFIGURED_LTD["state"]
+    st = _CONFIGURED_LTD["state"]
+    return None if st is _LTD_FORCE_EMPTY else st
 
 
 def configured_ltd_engaged() -> bool:
@@ -208,7 +222,7 @@ def random_ltd_scan(layer, x, stacked_params, rng, keep: int):
         return x
     _CONFIGURED_LTD["engaged"] = True
     st = _CONFIGURED_LTD["state"]
-    if st is not None:
+    if isinstance(st, dict):  # never the force-empty sentinel
         st["engaged"] = True  # per-engine truth (the global resets each trace)
     x, _ = layer(x, take(0))
     mids = jax.tree_util.tree_map(lambda l: l[1:-1], stacked_params)

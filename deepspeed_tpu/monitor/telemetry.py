@@ -1,0 +1,272 @@
+"""Unified telemetry subsystem.
+
+One collector joins the observability islands the reference spreads over
+``wall_clock_breakdown`` timers, ``see_memory_usage``, the comms logger, the
+FLOPs profiler and the monitor writers (deepspeed/runtime/engine.py
+``_report_progress`` + monitor/monitor.py): per train step it assembles ONE
+structured record — loss, grad-norm, lr, step wall-time, samples/sec,
+tokens/sec, model-FLOPs-utilization, HBM high-water mark — and fans it out to
+
+- ``MonitorMaster`` (TensorBoard / W&B / CSV writers, rank-0 only), and
+- a rank-0 JSONL sink (``TelemetryConfig.jsonl_path``), one json object per
+  line, machine-readable for regression tracking (bench.py computes the same
+  MFU externally; this makes the engine report about itself).
+
+It also owns config-driven ``jax.profiler`` capture windows
+(``profile_step_start``/``profile_step_stop`` → ``start_trace``/``stop_trace``
+into a TensorBoard-readable directory) and hands out ``StepTraceAnnotation`` /
+``TraceAnnotation`` context managers so the engine's step, batch-prep and
+checkpoint IO show up as named ranges in the trace.
+
+MFU derivation (ISSUE: bench.py parity): ``flops_per_step`` comes ONCE from
+the XLA cost analysis of the compiled train step (FlopsProfiler), divided by
+the measured wall-time and the per-chip peak FLOPs × chip count.  Peak FLOPs
+resolve from ``TelemetryConfig.peak_flops_per_chip``, the
+``PALLAS_AXON_TPU_GEN`` env (the bench.py convention), or the device kind;
+unknown hardware (CPU test backend) yields ``mfu: null`` unless the config
+pins a peak.
+"""
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from ..utils.memory import device_memory_stats
+
+Event = Tuple[str, float, int]
+
+# bf16 peak FLOPs per chip by TPU generation (bench.py PEAK_FLOPS)
+PEAK_FLOPS_BY_GEN = {
+    "v6e": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+}
+
+_FLOPS_UNSET = object()  # distinguishes "not yet profiled" from "profiling failed"
+
+
+def detect_peak_flops_per_chip() -> Optional[float]:
+    """Per-chip bf16 peak from env (bench.py convention) or device kind;
+    None when the hardware is unknown (e.g. the CPU test backend)."""
+    probe = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    try:
+        import jax
+        probe += " " + getattr(jax.devices()[0], "device_kind", "")
+    except Exception:
+        pass
+    probe = probe.lower().replace("tpu ", "").replace(" lite", "e")
+    for gen, peak in PEAK_FLOPS_BY_GEN.items():
+        if gen in probe:
+            return peak
+    return None
+
+
+class TelemetryCollector:
+    """Assembles per-step records and fans them out (monitor + JSONL).
+
+    Disabled collectors (``config.enabled`` false and no ``jsonl_path``) keep
+    every method a cheap no-op, so call sites never branch.
+    """
+
+    def __init__(self, config=None, monitor=None, batch_size: int = 1,
+                 n_chips: Optional[int] = None):
+        from ..runtime.config import TelemetryConfig
+        self.config = config if config is not None else TelemetryConfig()
+        self.monitor = monitor
+        self.batch_size = max(int(batch_size), 1)
+        self.enabled = bool(self.config.enabled)
+        try:
+            import jax
+            self._is_rank0 = jax.process_index() == 0
+            self.n_chips = int(n_chips) if n_chips else jax.device_count()
+        except Exception:
+            self._is_rank0 = True
+            self.n_chips = int(n_chips) if n_chips else 1
+        self.peak_flops_per_chip = (self.config.peak_flops_per_chip
+                                    if self.config.peak_flops_per_chip is not None
+                                    else detect_peak_flops_per_chip())
+        self._flops_per_step: Any = _FLOPS_UNSET
+        self._jsonl_fh = None
+        self._tracing = False
+        self._profile_done = False  # the capture window fires at most once
+        self.records_written = 0
+        # requests/sec rate tracking for serving gauges (name -> (t, count))
+        self._rates: Dict[str, Tuple[float, float]] = {}
+
+    # ------------------------------------------------------------- flops / mfu
+    def wants_flops(self) -> bool:
+        """True while the one-time train-step cost analysis is still pending."""
+        return self.enabled and self._flops_per_step is _FLOPS_UNSET
+
+    def set_flops_per_step(self, flops: Optional[float]) -> None:
+        self._flops_per_step = float(flops) if flops else None
+
+    @property
+    def flops_per_step(self) -> Optional[float]:
+        return None if self._flops_per_step is _FLOPS_UNSET else self._flops_per_step
+
+    def _mfu(self, step_time_s: Optional[float]) -> Optional[float]:
+        flops = self.flops_per_step
+        if not flops or not step_time_s or not self.peak_flops_per_chip:
+            return None
+        return flops / step_time_s / (self.peak_flops_per_chip * self.n_chips)
+
+    # ----------------------------------------------------------------- records
+    def record_train_step(self, *, step: int, samples: int, loss: Optional[float] = None,
+                          grad_norm: Optional[float] = None, lr: Optional[float] = None,
+                          step_time_s: Optional[float] = None, tokens: Optional[int] = None,
+                          extra: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, Any]]:
+        """One structured record per optimizer step; returns the record (None
+        when disabled).  ``tokens`` is the global token count this step; when
+        the batch has no sequence dim it defaults to one token per sample so
+        tokens/sec degrades to samples/sec instead of going null."""
+        if not self.enabled:
+            return None
+        tokens = int(tokens) if tokens else self.batch_size
+        step_time_ms = step_time_s * 1e3 if step_time_s else None
+        samples_per_sec = self.batch_size / step_time_s if step_time_s else None
+        tokens_per_sec = tokens / step_time_s if step_time_s else None
+        flops = self.flops_per_step
+        record: Dict[str, Any] = {
+            "kind": "train_step",
+            "step": int(step),
+            "samples": int(samples),
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "lr": lr,
+            "step_time_ms": step_time_ms,
+            "samples_per_sec": samples_per_sec,
+            "tokens_per_sec": tokens_per_sec,
+            "flops_per_step": flops,
+            "tflops_per_sec": (flops / step_time_s / 1e12 if flops and step_time_s else None),
+            "mfu": self._mfu(step_time_s),
+            "hbm": device_memory_stats(),
+            "timestamp": time.time(),
+        }
+        if extra:
+            record.update(extra)
+        self._write_jsonl(record)
+        return record
+
+    def record_gauges(self, gauges: Dict[str, Any], step: int,
+                      prefix: str = "Inference") -> Optional[Dict[str, Any]]:
+        """Point-in-time gauges (scheduler/serving state) → monitor events and
+        a ``kind: gauges`` JSONL record."""
+        if not self.enabled:
+            return None
+        self.record_events([(f"{prefix}/{k}", float(v), int(step))
+                            for k, v in gauges.items() if v is not None])
+        record = {"kind": "gauges", "prefix": prefix, "step": int(step),
+                  "timestamp": time.time(), **gauges}
+        self._write_jsonl(record)
+        return record
+
+    def record_events(self, events: List[Event]) -> None:
+        """Fan events out to MonitorMaster (rank-0; no JSONL — events are the
+        monitor-native shape, records are the JSONL-native shape)."""
+        if not self.enabled or not events:
+            return
+        if self.monitor is not None and self._is_rank0:
+            self.monitor.write_events(list(events))
+
+    def rate(self, name: str, count: float) -> Optional[float]:
+        """Per-second rate of a monotonically increasing counter between
+        successive calls (None on the first observation of ``name``)."""
+        now = time.perf_counter()
+        prev = self._rates.get(name)
+        self._rates[name] = (now, count)
+        if prev is None or now <= prev[0]:
+            return None
+        return (count - prev[1]) / (now - prev[0])
+
+    # ------------------------------------------------------------- JSONL sink
+    def _write_jsonl(self, record: Dict[str, Any]) -> None:
+        path = self.config.jsonl_path
+        if path is None or not self._is_rank0:
+            return
+        if self._jsonl_fh is None:
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._jsonl_fh = open(path, "a")
+        self._jsonl_fh.write(json.dumps(record) + "\n")
+        self._jsonl_fh.flush()
+        self.records_written += 1
+
+    # ------------------------------------------------- jax.profiler windows
+    @property
+    def tracing(self) -> bool:
+        return self._tracing
+
+    def profile_step_boundary(self, step: int) -> None:
+        """Drive the configured capture window; call at the top of each train
+        step with the CURRENT global step.  The window is [start, stop):
+        start_trace fires entering any step inside the window (>= start, so a
+        checkpoint-resumed run landing mid-window still captures), stop_trace
+        entering ``profile_step_stop`` (or at close()); one window per run."""
+        if not self.enabled:
+            return
+        start, stop = self.config.profile_step_start, self.config.profile_step_stop
+        if self._tracing and stop >= 0 and step >= stop:
+            self.stop_trace()
+            self._profile_done = True
+        if (not self._tracing and not self._profile_done and start >= 0
+                and step >= start and (stop < 0 or step < stop)):
+            self.start_trace()
+
+    def start_trace(self) -> bool:
+        if self._tracing:
+            return False
+        try:
+            import jax
+            os.makedirs(self.config.profile_dir, exist_ok=True)
+            jax.profiler.start_trace(self.config.profile_dir)
+            self._tracing = True
+            logger.info(f"telemetry: jax.profiler trace started -> {self.config.profile_dir}")
+        except Exception as e:  # a failed trace must never kill training
+            logger.warning(f"telemetry: start_trace failed: {e}")
+        return self._tracing
+
+    def stop_trace(self) -> None:
+        if not self._tracing:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            logger.info(f"telemetry: jax.profiler trace stopped ({self.config.profile_dir})")
+        except Exception as e:
+            logger.warning(f"telemetry: stop_trace failed: {e}")
+        finally:
+            self._tracing = False
+
+    def step_annotation(self, step: int):
+        """StepTraceAnnotation for the train step — the marker TensorBoard's
+        profile tooling groups per-step stats by."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        import jax
+        return jax.profiler.StepTraceAnnotation("train_step", step_num=int(step))
+
+    def annotation(self, name: str):
+        """Named TraceAnnotation (batch-prep, checkpoint IO, eval, ...)."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+
+    # ---------------------------------------------------------------- teardown
+    def close(self) -> None:
+        self.stop_trace()
+        if self._jsonl_fh is not None:
+            self._jsonl_fh.close()
+            self._jsonl_fh = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -140,12 +140,23 @@ class FlopsProfiler:
         self.engine = engine
         self._result: Optional[ProfileResult] = None
 
-    def profile_train_step(self, batch) -> ProfileResult:
+    def profile_train_step(self, batch, pre_sharded: bool = False) -> ProfileResult:
+        """``pre_sharded``: batch is already gas-laid-out AND device-placed (the
+        engine's in-step call) — re-running the layout would mis-reshape when
+        gas == train_batch_size."""
         eng = self.engine
-        batch = eng._ensure_gas_layout(batch)
-        batch = eng._shard_batch(batch)
+        if not pre_sharded:
+            batch = eng._ensure_gas_layout(batch)
+            batch = eng._shard_batch(batch)
         lowered = jax.jit(lambda s, b: eng.train_step_fn(s, b)).lower(eng.state, batch)
-        cost = lowered.compile().cost_analysis()
+        try:
+            # cost analysis straight off the lowered HLO — no second backend
+            # compile of the train step (which can take minutes on TPU)
+            cost = lowered.cost_analysis()
+        except Exception:
+            cost = None
+        if not cost:
+            cost = lowered.compile().cost_analysis()
         if isinstance(cost, list):
             cost = cost[0] if cost else {}
         n_params = sum(int(np.size(p)) for p in jax.tree_util.tree_leaves(eng.state.params))

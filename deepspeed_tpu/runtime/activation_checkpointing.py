@@ -137,13 +137,17 @@ def offload_checkpoint(layer_fn):
     def _guard_rest(rest):
         # *rest gets None cotangents in bwd — a differentiable float extra
         # (per-layer scale, bias, tables) would silently train with zero
-        # gradient, so refuse it loudly; int extras (positions) are fine
+        # gradient, so refuse it loudly; int extras (positions) are fine.
+        # jnp.issubdtype, NOT np: numpy's lattice doesn't place bfloat16 (or
+        # fp8) under np.inexact, so the engine's common compute dtype would
+        # slip through the guard (ADVICE r5 low)
         import numpy as np
+        import jax.numpy as jnp
         for leaf in jax.tree_util.tree_leaves(rest):
             if isinstance(leaf, np.ndarray):
                 continue  # plain numpy constants can never carry gradients
             dt = getattr(leaf, "dtype", None)
-            if dt is not None and np.issubdtype(dt, np.inexact):
+            if dt is not None and jnp.issubdtype(dt, jnp.inexact):
                 raise TypeError(
                     "offload_checkpoint: extra args (*rest) receive no gradient; "
                     "found a float-dtype extra — pass differentiable values "

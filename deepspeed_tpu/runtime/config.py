@@ -177,6 +177,48 @@ class FlopsProfilerConfig(ConfigModel):
     output_file: Optional[str] = None
 
 
+class TelemetryConfig(ConfigModel):
+    """Unified telemetry (TPU-native; no single reference analog — subsumes the
+    reference's wall_clock_breakdown timers + see_memory_usage + monitor event
+    wiring into one per-step record stream, monitor/telemetry.py).
+
+    ``enabled`` (or a non-None ``jsonl_path``) turns on per-step structured
+    records: loss, grad-norm, lr, step wall-time, samples/sec, tokens/sec, MFU
+    and HBM stats, fanned out to MonitorMaster and a rank-0 JSONL sink.
+
+    ``profile_step_start``/``profile_step_stop`` open a ``jax.profiler`` trace
+    window over those global steps (TensorBoard-readable files under
+    ``profile_dir``), with StepTraceAnnotation on each step and TraceAnnotation
+    around batch-prep and checkpoint IO.
+
+    Cost: a per-step record needs the step's loss and wall-time, so enabling
+    telemetry adds ONE host value-fetch (device sync) per train step — host
+    work stops overlapping device execution, like ``wall_clock_breakdown``.
+    Leave it off for maximum-throughput runs and sample with a profiler window
+    instead.
+    """
+    enabled: bool = False
+    jsonl_path: Optional[str] = None
+    # -1 disables; [start, stop) in global steps, mirroring the reference's
+    # flops_profiler profile_step single-shot trigger but as a window
+    profile_step_start: int = Field(-1, ge=-1)
+    profile_step_stop: int = Field(-1, ge=-1)
+    profile_dir: str = "profiler_traces"
+    # see_memory_usage(tag) at each steps_per_print boundary (also honors the
+    # reference's top-level memory_breakdown key)
+    memory_breakdown: bool = False
+    # per-chip peak FLOPs override for MFU; None => detect from device_kind
+    peak_flops_per_chip: Optional[float] = Field(None, gt=0.0)
+
+    def model_validate(self):
+        if self.jsonl_path is not None and not self.enabled:
+            object.__setattr__(self, "enabled", True)
+        if (self.profile_step_stop >= 0 and self.profile_step_start >= 0
+                and self.profile_step_stop <= self.profile_step_start):
+            raise ValueError(f"telemetry: profile_step_stop={self.profile_step_stop} must be "
+                             f"> profile_step_start={self.profile_step_start}")
+
+
 class MeshConfig(ConfigModel):
     """TPU-native: explicit device-mesh axis sizes.
 
@@ -391,6 +433,7 @@ class TrainingConfig(ConfigModel):
     wandb: WandbConfig = Field(WandbConfig)
     csv_monitor: CSVConfig = Field(CSVConfig)
     flops_profiler: FlopsProfilerConfig = Field(FlopsProfilerConfig)
+    telemetry: TelemetryConfig = Field(TelemetryConfig)
     mesh: MeshConfig = Field(MeshConfig)
     gradient_compression: GradientCompressionConfig = Field(GradientCompressionConfig)
     sparse_attention: Optional[SparseAttentionConfig] = None
@@ -418,6 +461,10 @@ class TrainingConfig(ConfigModel):
             object.__setattr__(self, "bf16", BF16Config(enabled=not self.fp16.enabled))
         if self.checkpoint.tag_validation is not None:
             object.__setattr__(self, "checkpoint_tag_validation", self.checkpoint.tag_validation)
+        if self.memory_breakdown and not self.telemetry.memory_breakdown:
+            # the reference's top-level memory_breakdown key routes to the same
+            # see_memory_usage cadence the telemetry section controls
+            object.__setattr__(self.telemetry, "memory_breakdown", True)
 
     def checkpoint_engine_kind(self) -> str:
         """Engine plug-in selection (reference _configure_checkpointing,

@@ -25,8 +25,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..monitor.monitor import MonitorMaster
+from ..monitor.telemetry import TelemetryCollector
 from ..parallel.mesh import MeshTopology, set_topology
 from ..utils.logging import log_dist, logger
+from ..utils.memory import see_memory_usage
 from ..utils.timer import ThroughputTimer
 from . import lr_schedules, optimizers
 from .checkpointing import load_checkpoint_dir, save_checkpoint_dir
@@ -138,6 +140,9 @@ class Engine:
         self.compute_dtype = config.precision_dtype
         self.fp16_enabled = config.fp16.enabled
         self.monitor = MonitorMaster(config)
+        self.telemetry = TelemetryCollector(config.telemetry, monitor=self.monitor,
+                                            batch_size=self.train_batch_size)
+        self._last_telemetry_record = None
         self.throughput = ThroughputTimer(batch_size=self.train_batch_size)
         self.global_steps = 0
         self.global_samples = 0
@@ -653,15 +658,27 @@ class Engine:
         if self._nvme_trainer is not None:
             # ZeRO-Infinity layer streaming: one layer (+ its Adam state) on
             # device / in host buffers at a time; batch passes through whole
+            self.telemetry.profile_step_boundary(self.global_steps)
             self.throughput.start()
             lr = float(self.lr_schedule(self.global_steps))
-            loss = self._nvme_trainer.train_step(batch, lr=lr)
+            t0 = time.perf_counter()
+            with self.telemetry.step_annotation(self.global_steps):
+                loss = self._nvme_trainer.train_step(batch, lr=lr)
+            step_time = time.perf_counter() - t0
             metrics = StepMetrics(loss=jnp.float32(loss), grad_norm=jnp.float32(0.0),
                                   lr=jnp.float32(lr), skipped=jnp.asarray(False),
                                   loss_scale=jnp.float32(1.0))
             self.global_steps += 1
             self.global_samples += self.train_batch_size
             self.lr_scheduler.last_step = self.global_steps
+            if self.telemetry.enabled:
+                # XLA cost analysis of the streamed layer loop is not one
+                # program; MFU stays null on this path
+                self.telemetry.set_flops_per_step(None)
+                self._last_telemetry_record = self.telemetry.record_train_step(
+                    step=self.global_steps, samples=self.global_samples,
+                    loss=float(loss), grad_norm=0.0, lr=lr, step_time_s=step_time,
+                    tokens=self._batch_tokens(batch, seq_dim=1))
             self._maybe_report(metrics)
             return metrics
         if self._ltd_state is not None:
@@ -680,20 +697,29 @@ class Engine:
                 self._ltd_state["keep"] = new_keep
                 self._compiled_step = None
                 self._offload_grad_fn = None  # offload path re-traces at the new budget
+        telemetry = self.telemetry.enabled
+        if telemetry:
+            self.telemetry.profile_step_boundary(self.global_steps)
         breakdown = self.config.wall_clock_breakdown
-        t0 = time.perf_counter() if breakdown else 0.0
-        batch = self._ensure_gas_layout(batch)
-        batch = self._shard_batch(batch)
-        t1 = time.perf_counter() if breakdown else 0.0
+        timed = breakdown or telemetry
+        t0 = time.perf_counter() if timed else 0.0
+        with self.telemetry.annotation("batch_prep"):
+            batch = self._ensure_gas_layout(batch)
+            batch = self._shard_batch(batch)
+        t1 = time.perf_counter() if timed else 0.0
         self.throughput.start()
-        if self.offload_device is not None:
-            metrics = self._offload_train_batch(batch)
-        else:
-            self.state, metrics = self.train_step_fn(self.state, batch)
-        if breakdown:
+        with self.telemetry.step_annotation(self.global_steps):
+            if self.offload_device is not None:
+                metrics = self._offload_train_batch(batch)
+            else:
+                self.state, metrics = self.train_step_fn(self.state, batch)
+        loss_val = None
+        t2 = 0.0
+        if timed:
             # a value fetch is the only true sync; keep it off the fast path
-            float(metrics.loss)
+            loss_val = float(metrics.loss)
             t2 = time.perf_counter()
+        if breakdown:
             self._breakdown_acc = getattr(self, "_breakdown_acc", [0.0, 0.0, 0])
             self._breakdown_acc[0] += t1 - t0
             self._breakdown_acc[1] += t2 - t1
@@ -709,8 +735,50 @@ class Engine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self.lr_scheduler.last_step = self.global_steps
-        self._maybe_report(metrics)
+        if telemetry:
+            if self.telemetry.wants_flops():
+                self.telemetry.set_flops_per_step(self._train_step_flops(batch))
+            self._last_telemetry_record = self.telemetry.record_train_step(
+                step=self.global_steps, samples=self.global_samples,
+                loss=loss_val, grad_norm=float(metrics.grad_norm),
+                lr=float(metrics.lr), step_time_s=max(t2 - t1, 0.0) or None,
+                tokens=self._batch_tokens(batch))
+        if (self.config.telemetry.memory_breakdown
+                and self.global_steps % self.config.steps_per_print == 0):
+            # memory_breakdown stands alone: the reference's top-level key must
+            # snapshot even when per-step telemetry records are off
+            see_memory_usage(f"after train step {self.global_steps}")
+        self._maybe_report(metrics, loss=loss_val)
         return metrics
+
+    def _train_step_flops(self, sharded_batch) -> Optional[float]:
+        """One-time per-step FLOPs from the XLA cost analysis of the compiled
+        train step (FlopsProfiler, fed the exact batch the step runs on — no
+        re-layout); None on the offload paths (the step is not one jitted
+        program there) or when cost analysis is unavailable."""
+        if self.offload_device is not None or self._nvme_trainer is not None:
+            return None
+        try:
+            from ..profiling.flops_profiler import FlopsProfiler
+            return FlopsProfiler(self).profile_train_step(sharded_batch,
+                                                          pre_sharded=True).flops
+        except Exception as e:
+            logger.warning(f"telemetry: train-step cost analysis failed ({e}); mfu stays null")
+            return None
+
+    def _batch_tokens(self, batch, seq_dim: int = 2) -> Optional[int]:
+        """Global tokens this step: train_batch_size * seq_len, with seq_len
+        read off the first integer-dtype leaf carrying a sequence dim —
+        ``seq_dim=2`` for the gas layout ([gas, micro, seq, ...]), ``seq_dim=1``
+        for raw [batch, seq, ...] batches (the NVMe streaming path, which never
+        gas-reshapes).  None for sequence-free batches (telemetry then counts
+        one token per sample)."""
+        for leaf in jax.tree_util.tree_leaves(batch):
+            shape = getattr(leaf, "shape", ())
+            dt = getattr(leaf, "dtype", None)
+            if len(shape) > seq_dim and dt is not None and jnp.issubdtype(dt, jnp.integer):
+                return self.train_batch_size * int(shape[seq_dim])
+        return None
 
     def _ensure_gas_layout(self, batch):
         gas = self.gradient_accumulation_steps
@@ -778,21 +846,52 @@ class Engine:
                                  PartitionSpec(dp_axes if len(dp_axes) > 1 else dp_axes[0]))
         batch = jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
         params = self._compute_params if self.offload_device is not None else self.state.params
-        return self._compiled_eval(params, batch, rng)
+        if not self.telemetry.enabled:
+            return self._compiled_eval(params, batch, rng)
+        t0 = time.perf_counter()
+        with self.telemetry.annotation("eval_batch"):
+            loss = self._compiled_eval(params, batch, rng)
+            loss_val = float(loss)  # sync so the measured time covers execution
+        self.telemetry.record_events([
+            ("Eval/loss", loss_val, self.global_samples),
+            ("Eval/batch_time_ms", (time.perf_counter() - t0) * 1e3, self.global_samples)])
+        return loss
 
     # ----------------------------------------------------------- reporting
-    def _maybe_report(self, metrics: StepMetrics):
+    def _maybe_report(self, metrics: StepMetrics, loss: Optional[float] = None):
         if self.global_steps % self.config.steps_per_print == 0:
             elapsed = self.throughput.stop()
-            loss = float(metrics.loss)
+            loss = float(metrics.loss) if loss is None else loss
             log_dist(
                 f"step={self.global_steps} loss={loss:.4f} lr={float(metrics.lr):.3e} "
                 f"grad_norm={float(metrics.grad_norm):.3f}"
                 + (f" loss_scale={float(metrics.loss_scale):.0f}" if self.fp16_enabled else "")
                 + (f" samples/sec={self.throughput.avg_samples_per_sec():.1f}" if elapsed else ""),
                 ranks=[0])
-            self.monitor.write_events([(f"Train/Samples/train_loss", loss, self.global_samples),
-                                       (f"Train/Samples/lr", float(metrics.lr), self.global_samples)])
+            samples = self.global_samples
+            events = [("Train/Samples/train_loss", loss, samples),
+                      ("Train/Samples/lr", float(metrics.lr), samples),
+                      ("Train/Samples/grad_norm", float(metrics.grad_norm), samples)]
+            if self.fp16_enabled:
+                events.append(("Train/Samples/loss_scale", float(metrics.loss_scale), samples))
+            rec = self._last_telemetry_record
+            if elapsed and (rec is None or rec.get("samples_per_sec") is None):
+                # telemetry's per-step rate supersedes the running average
+                events.append(("Train/Samples/samples_per_sec",
+                               self.throughput.avg_samples_per_sec(), samples))
+            if rec is not None:
+                for key in ("step_time_ms", "samples_per_sec", "tokens_per_sec",
+                            "tflops_per_sec", "mfu"):
+                    if rec.get(key) is not None:
+                        events.append((f"Train/Samples/{key}", float(rec[key]), samples))
+                for key, value in (rec.get("hbm") or {}).items():
+                    if value is not None:
+                        events.append((f"Train/HBM/{key}", float(value), samples))
+            if self.config.comms_logger.enabled:
+                # comms-logger summary rides the same monitor event stream
+                from ..utils.comms_logging import get_comms_logger
+                events.extend(get_comms_logger().as_events(samples))
+            self.monitor.write_events(events)
 
     @property
     def lr(self):
@@ -848,8 +947,12 @@ class Engine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         state = self.state if self.offload_device is None else self._offload_host_state()
-        save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config,
-                            engine=self.checkpoint_engine)
+        t0 = time.perf_counter()
+        with self.telemetry.annotation("checkpoint_save"):
+            save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config,
+                                engine=self.checkpoint_engine)
+        self.telemetry.record_events([("Train/Checkpoint/save_time_ms",
+                                       (time.perf_counter() - t0) * 1e3, self.global_samples)])
         return tag
 
     def _offload_host_state(self):
@@ -868,21 +971,28 @@ class Engine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
         self._nvme_guard("load_checkpoint")
-        if self.config.load_universal_checkpoint:
-            return self._load_universal_checkpoint(load_dir, tag, load_optimizer_states)
-        if self.offload_device is not None:
-            return self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
-        state, client_state = load_checkpoint_dir(load_dir,
-                                                 tag,
-                                                 self.state,
-                                                 self._state_shardings(jax.eval_shape(lambda s: s, self.state)),
-                                                 load_optimizer_states=load_optimizer_states)
-        self.state = state
-        self.global_steps = client_state.get("global_steps", 0)
-        self.global_samples = client_state.get("global_samples", 0)
-        if "lr_scheduler" in client_state:
-            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
-        return tag, client_state
+        t0 = time.perf_counter()
+        with self.telemetry.annotation("checkpoint_load"):
+            if self.config.load_universal_checkpoint:
+                out = self._load_universal_checkpoint(load_dir, tag, load_optimizer_states)
+            elif self.offload_device is not None:
+                out = self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
+            else:
+                state, client_state = load_checkpoint_dir(
+                    load_dir,
+                    tag,
+                    self.state,
+                    self._state_shardings(jax.eval_shape(lambda s: s, self.state)),
+                    load_optimizer_states=load_optimizer_states)
+                self.state = state
+                self.global_steps = client_state.get("global_steps", 0)
+                self.global_samples = client_state.get("global_samples", 0)
+                if "lr_scheduler" in client_state:
+                    self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+                out = (tag, client_state)
+        self.telemetry.record_events([("Train/Checkpoint/load_time_ms",
+                                       (time.perf_counter() - t0) * 1e3, self.global_samples)])
+        return out
 
     def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True):
         from .checkpointing import get_latest_tag

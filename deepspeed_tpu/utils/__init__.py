@@ -1,3 +1,4 @@
 from .logging import logger, log_dist, print_rank_0, warning_once
+from .memory import device_memory_stats, live_array_census, see_memory_usage
 from .tensor_fragment import (safe_get_full_fp32_param, safe_get_full_grad,
                               safe_get_full_optimizer_state, safe_set_full_fp32_param)

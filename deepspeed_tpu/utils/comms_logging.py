@@ -70,6 +70,29 @@ class CommsLogger:
     def record_traced(self, op_name: str, msg_size: int):
         self.traced_dict[op_name][msg_size] += 1
 
+    def as_events(self, step: int):
+        """Summarize per-op stats as monitor ``(tag, value, step)`` events —
+        the comms-logger → MonitorMaster bridge (the reference only prints its
+        summary; here it also flows into the telemetry event stream).  One
+        count/avg-latency/avg-busbw triple per op, aggregated over sizes, plus
+        trace-time counts for in-graph collectives."""
+        events = []
+        for record_name, sizes in sorted(self.comms_dict.items()):
+            count = sum(entry[0] for entry in sizes.values())
+            lats = [l for entry in sizes.values() for l in entry[1]]
+            bus = [b for entry in sizes.values() for b in entry[3]]
+            events.append((f"Comms/{record_name}/count", float(count), step))
+            if lats:
+                events.append((f"Comms/{record_name}/avg_latency_ms",
+                               sum(lats) / len(lats), step))
+            if bus:
+                events.append((f"Comms/{record_name}/avg_busbw_gbps",
+                               sum(bus) / len(bus), step))
+        for op, sizes in sorted(self.traced_dict.items()):
+            events.append((f"Comms/traced/{op}/count",
+                           float(sum(sizes.values())), step))
+        return events
+
     def log_summary(self, show_straggler=False):
         lines = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}"
                  f"{'Total Latency(ms)':<20}{'Avg Latency(ms)':<20}{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]

@@ -1,0 +1,62 @@
+"""Device-memory introspection.
+
+Analog of ``see_memory_usage`` (deepspeed/runtime/utils.py:835): the reference
+reads torch.cuda allocator counters; here the source of truth is the device's
+``memory_stats()`` (HBM bytes_in_use / peak_bytes_in_use / bytes_limit) plus a
+``jax.live_arrays()`` census standing in for the reference's "MA/CA" allocator
+split — on XLA the live-array view is the part of HBM the *framework* can name,
+the rest is compiler temp/fragmentation.
+
+Null-safe on backends without memory instrumentation (CPU ``memory_stats()``
+returns None): stats fields come back as None and the census still reports.
+"""
+
+from typing import Any, Dict, Optional
+
+from .logging import log_dist
+
+# memory_stats() keys surfaced in telemetry records and see_memory_usage lines
+HBM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats(device_index: int = 0) -> Dict[str, Optional[int]]:
+    """HBM counters for one local device, with every key present and None where
+    the backend has no instrumentation (CPU) — callers never need to branch."""
+    import jax
+    try:
+        raw = jax.local_devices()[device_index].memory_stats() or {}
+    except Exception:
+        raw = {}
+    return {k: (int(raw[k]) if k in raw else None) for k in HBM_KEYS}
+
+
+def live_array_census() -> Dict[str, int]:
+    """Count and total bytes of arrays the framework holds alive (the analog of
+    the reference's torch 'memory allocated'; XLA temps are invisible here)."""
+    import jax
+    count = 0
+    nbytes = 0
+    for a in jax.live_arrays():
+        count += 1
+        nbytes += int(getattr(a, "nbytes", 0) or 0)
+    return {"live_arrays": count, "live_array_bytes": nbytes}
+
+
+def _gb(n: Optional[int]) -> str:
+    return "n/a" if n is None else f"{n / 2**30:.2f}GB"
+
+
+def see_memory_usage(message: str, force: bool = True, device_index: int = 0) -> Dict[str, Any]:
+    """Log a one-line memory snapshot tagged ``message`` (reference
+    see_memory_usage prints MA/Max_MA/CA/Max_CA) and return it as a dict:
+    ``{bytes_in_use, peak_bytes_in_use, bytes_limit, live_arrays,
+    live_array_bytes}``."""
+    snap: Dict[str, Any] = dict(device_memory_stats(device_index))
+    snap.update(live_array_census())
+    if force:
+        log_dist(
+            f"{message} | HBM in_use={_gb(snap['bytes_in_use'])} "
+            f"peak={_gb(snap['peak_bytes_in_use'])} limit={_gb(snap['bytes_limit'])} "
+            f"| live arrays: {snap['live_arrays']} ({_gb(snap['live_array_bytes'])})",
+            ranks=[0])
+    return snap

@@ -17,6 +17,74 @@ import sys
 import time
 
 
+def telemetry_smoke():
+    """CI smoke for the unified telemetry subsystem (ISSUE 1 acceptance): a
+    3-step CPU train loop with wall_clock_breakdown + telemetry enabled must
+    produce 3 well-formed JSONL records (loss, step_time_ms, samples_per_sec,
+    tokens_per_sec, mfu, hbm — hbm null-safe on CPU) and jax.profiler trace
+    files under the configured dir."""
+    import os
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+
+    rng = np.random.default_rng(0)
+    hidden = 16
+
+    def loss_fn(params, batch, _rng):
+        import jax.numpy as jnp
+        h = jnp.maximum(batch["x"] @ params["w0"], 0.0)
+        pred = h @ params["w1"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"w0": rng.standard_normal((hidden, hidden)).astype("float32") * 0.1,
+              "w1": rng.standard_normal((hidden, hidden)).astype("float32") * 0.1}
+    tmp = tempfile.mkdtemp(prefix="dstpu_telemetry_smoke_")
+    jsonl = os.path.join(tmp, "telemetry.jsonl")
+    tracedir = os.path.join(tmp, "traces")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn,
+        model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "wall_clock_breakdown": True,
+            "telemetry": {"jsonl_path": jsonl,
+                          "profile_step_start": 1, "profile_step_stop": 2,
+                          "profile_dir": tracedir,
+                          # pinned so MFU is a real number on the CPU backend
+                          "peak_flops_per_chip": 1e12},
+        })
+    for step in range(3):
+        batch = {"x": rng.standard_normal((engine.train_batch_size, hidden)).astype("float32"),
+                 "y": rng.standard_normal((engine.train_batch_size, hidden)).astype("float32")}
+        engine.train_batch(batch)
+    engine.telemetry.close()
+
+    with open(jsonl) as fh:
+        records = [json.loads(line) for line in fh]
+    steps = [r for r in records if r.get("kind") == "train_step"]
+    assert len(steps) >= 3, f"expected >=3 train_step records, got {len(steps)}"
+    required = ("loss", "step_time_ms", "samples_per_sec", "tokens_per_sec", "mfu", "hbm")
+    for r in steps:
+        missing = [k for k in required if k not in r]
+        assert not missing, f"record {r['step']} missing fields {missing}"
+        assert r["loss"] is not None and np.isfinite(r["loss"])
+        assert r["step_time_ms"] > 0 and r["samples_per_sec"] > 0 and r["tokens_per_sec"] > 0
+        assert set(r["hbm"]) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+    assert steps[-1]["mfu"] is not None and steps[-1]["mfu"] > 0, "mfu did not resolve"
+    trace_files = [os.path.join(root, f)
+                   for root, _, files in os.walk(tracedir) for f in files]
+    assert trace_files, f"no jax.profiler trace files under {tracedir}"
+    print(json.dumps({"telemetry_smoke": "ok", "records": len(steps),
+                      "trace_files": len(trace_files), "jsonl": jsonl}))
+    return 0
+
+
 def run_lane(name: str, marker_args):
     t0 = time.time()
     proc = subprocess.run([sys.executable, "-m", "pytest", "tests/", "-q", *marker_args],
@@ -42,4 +110,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--telemetry-smoke" in sys.argv:
+        sys.exit(telemetry_smoke())
     sys.exit(main())

@@ -156,3 +156,22 @@ def test_offload_checkpoint_rejects_float_extras():
     x = jnp.ones((2, 4)); p = jnp.eye(4)
     with _pytest.raises(TypeError, match="no gradient"):
         jax.grad(lambda p_: jnp.sum(wrapped(x, p_, jnp.float32(2.0))[0]))(p)
+
+
+def test_offload_checkpoint_rejects_bf16_extras():
+    """np.issubdtype misses bfloat16 (not under np.inexact), so a bf16 extra —
+    the engine's common compute dtype — used to slip the guard and train with
+    a silent zero gradient (ADVICE r5).  jnp's lattice must refuse it loudly."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from deepspeed_tpu.runtime.activation_checkpointing import offload_checkpoint
+
+    def layer(x, p, scale):
+        return jnp.tanh(x @ p) * scale.astype(x.dtype), None
+
+    wrapped = offload_checkpoint(layer)
+    x = jnp.ones((2, 4)); p = jnp.eye(4)
+    with _pytest.raises(TypeError, match="no gradient"):
+        jax.grad(lambda p_: jnp.sum(wrapped(x, p_, jnp.bfloat16(2.0))[0]))(p)

@@ -236,3 +236,49 @@ def test_random_ltd_reaches_engine_from_config():
     assert tr.configured_ltd_engaged()  # the forward actually routed through LTD
     # linear ramp 8 -> 16 over 4 steps, quantized to seq_per_step=4
     assert keeps == [8, 8, 12, 12, 16], keeps
+
+
+def test_random_ltd_eval_is_rng_independent():
+    """ADVICE r5 (medium): eval must measure the FULL model.  The engine's
+    empty LTD pin is authoritative over the train wrapper initialize()
+    installed, so eval loss is rng-independent and equals the no-LTD loss."""
+    import deepspeed_tpu
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.models import transformer as tr
+    from deepspeed_tpu.parallel import MeshTopology
+
+    S = 32
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=3, heads=4, kv_heads=4, seq=S)
+    topo = MeshTopology.from_axis_dict({"data": 1}, devices=jax.devices()[:1])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=llama.make_loss_fn(cfg),
+        model_parameters=llama.init_params(cfg, jax.random.PRNGKey(0)),
+        topology=topo,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": False},
+            "data_efficiency": {
+                "enabled": True,
+                "data_routing": {
+                    "enabled": True,
+                    "random_ltd": {"random_ltd_schedule": {
+                        "min_value": 8, "max_value": 16,
+                        "schedule_config": {"seq_per_step": 4, "require_steps": 4}}},
+                },
+            },
+        })
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, S))
+    batch = llama.causal_lm_batch(ids)
+    engine.train_batch(batch)  # train path engages token dropping
+    assert tr.configured_ltd_engaged()
+    l1 = float(engine.eval_batch(batch, rng=jax.random.PRNGKey(1)))
+    l2 = float(engine.eval_batch(batch, rng=jax.random.PRNGKey(2)))
+    assert l1 == l2, f"eval loss depends on rng (LTD leaked into eval): {l1} vs {l2}"
+    # and it matches the unwrapped full-model loss on the same params
+    plain = llama.make_loss_fn(cfg)
+    p32 = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), engine.state.params)
+    ref = float(plain(p32, batch, jax.random.PRNGKey(7)))
+    np.testing.assert_allclose(l1, ref, rtol=1e-5)

@@ -212,6 +212,37 @@ def test_save_16bit_model(tmp_path, mesh8):
     np.testing.assert_allclose(np.asarray(w, np.float32), master, atol=2e-2, rtol=2e-2)
 
 
+def test_monitor_events_beyond_loss_lr(tmp_path):
+    """_maybe_report must emit grad_norm / throughput / telemetry-derived
+    events, not just train_loss + lr (ISSUE 1: engine self-reporting)."""
+
+    class SpyMonitor:
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, events):
+            self.events.extend(events)
+
+    engine = make_engine(extra_cfg={
+        "steps_per_print": 1,
+        "telemetry": {"jsonl_path": str(tmp_path / "t.jsonl"),
+                      "peak_flops_per_chip": 1e12},
+    })
+    spy = SpyMonitor()
+    engine.monitor = spy
+    engine.telemetry.monitor = spy
+    train_losses(engine, steps=4)
+    tags = {t for t, _, _ in spy.events}
+    assert "Train/Samples/train_loss" in tags and "Train/Samples/lr" in tags
+    for expected in ("Train/Samples/grad_norm", "Train/Samples/step_time_ms",
+                     "Train/Samples/samples_per_sec", "Train/Samples/tokens_per_sec",
+                     "Train/Samples/mfu"):
+        assert expected in tags, f"missing monitor event {expected}: {sorted(tags)}"
+    # events carry the sample count as the step axis (reference Train/Samples/*)
+    loss_events = [(v, s) for t, v, s in spy.events if t == "Train/Samples/train_loss"]
+    assert [s for _, s in loss_events] == [engine.train_batch_size * (i + 1) for i in range(4)]
+
+
 def test_wall_clock_breakdown_logs(mesh8):
     import deepspeed_tpu
     from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
