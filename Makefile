@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -23,3 +23,8 @@ bench:
 # records (loss/step_time/throughput/mfu/hbm) + jax.profiler trace files
 telemetry-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --telemetry-smoke
+
+# kill-a-save-mid-write → 'latest' untouched → fresh engine resumes from the
+# last valid checkpoint → 3-step loss continuity (fault-injection harness)
+resilience-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --resilience-smoke

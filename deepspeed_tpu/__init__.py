@@ -14,6 +14,7 @@ from .comm import init_distributed
 from .runtime import zero
 from .parallel.mesh import MeshTopology
 from .runtime.config import TrainingConfig, load_config
+from .runtime.checkpointing import CheckpointError
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
 
 

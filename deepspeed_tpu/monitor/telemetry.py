@@ -165,6 +165,22 @@ class TelemetryCollector:
         self._write_jsonl(record)
         return record
 
+    def record_resilience(self, event: str, *, step: int = 0, samples: int = 0,
+                          **fields) -> Optional[Dict[str, Any]]:
+        """Fault-path happenings (save retries, fallback loads, watchdog trips,
+        preemption saves) → a ``kind: resilience`` JSONL record plus monitor
+        events for the numeric fields, so recoveries are visible in the same
+        stream as the steps they interrupt."""
+        if not self.enabled:
+            return None
+        record = {"kind": "resilience", "event": event, "step": int(step),
+                  "timestamp": time.time(), **fields}
+        self._write_jsonl(record)
+        self.record_events([(f"Resilience/{event}/{k}", float(v), int(samples))
+                            for k, v in fields.items()
+                            if isinstance(v, (int, float)) and not isinstance(v, bool)])
+        return record
+
     def record_events(self, events: List[Event]) -> None:
         """Fan events out to MonitorMaster (rank-0; no JSONL — events are the
         monitor-native shape, records are the JSONL-native shape)."""

@@ -6,6 +6,13 @@ TorchCheckpointEngine, async NebulaCheckpointEngine:20): an engine owns how
 leaf arrays get persisted.  The native engine writes .npy files; the async
 engine stages host copies and writes on a background thread so the train loop
 isn't blocked on disk (the Nebula tier-1 behavior).
+
+Save protocol contract (runtime/checkpointing.save_checkpoint_dir): leaves are
+written via ``save()``/streaming, then ``flush()`` must make every pending
+write visible (async engines drain their queue here), then — after the staging
+dir has been atomically renamed to its final tag — ``commit(tag)`` marks the
+tag durable.  ``commit`` therefore always sees a complete, manifest-bearing
+checkpoint directory.
 """
 
 import os
@@ -36,8 +43,14 @@ class CheckpointEngine:
     def load(self, path: str) -> np.ndarray:
         raise NotImplementedError
 
+    def flush(self) -> None:
+        """Make every ``save()`` issued so far visible on disk (barrier before
+        the manifest is written and the staging dir renamed).  Synchronous
+        engines are already flushed; async engines drain their queue."""
+
     def commit(self, tag: str) -> bool:
-        """Flush everything for ``tag``; returns True when durable."""
+        """Mark ``tag`` durable; called after the checkpoint dir is complete
+        (leaves + metadata.json in final position).  Returns True when durable."""
         return True
 
 
@@ -55,8 +68,8 @@ class NativeCheckpointEngine(CheckpointEngine):
 
 class AsyncCheckpointEngine(CheckpointEngine):
     """Background-thread writer (NebulaCheckpointEngine analog): save() enqueues
-    an already-host-resident array and returns immediately; commit() drains the
-    queue.  One writer thread preserves write order."""
+    an already-host-resident array and returns immediately; flush()/commit()
+    drain the queue.  One writer thread preserves write order."""
 
     supports_streaming_save = True  # same .npy-at-path layout; the streamed
     # write is synchronous, trading this leaf's async for the memory bound
@@ -75,23 +88,34 @@ class AsyncCheckpointEngine(CheckpointEngine):
             arr, path = item
             try:
                 np.save(path, arr)
-            except BaseException as exc:  # surfaced at commit()
+            except BaseException as exc:  # surfaced at flush()/commit()
                 self._error = exc
             finally:
                 self._queue.task_done()
 
+    def _raise_pending(self):
+        """Re-raise the writer thread's failure with its ORIGINAL type (an
+        OSError from a flaky mount stays an OSError, so the checkpoint retry
+        loop can recognize it as transient) and clear it so a retried save
+        starts clean."""
+        exc, self._error = self._error, None
+        if exc is not None:
+            raise exc
+
     def save(self, arr: np.ndarray, path: str) -> None:
         if self._error is not None:
-            raise RuntimeError(f"async checkpoint writer failed: {self._error}")
+            self._raise_pending()
         self._queue.put((np.asarray(arr), path))
 
     def load(self, path: str) -> np.ndarray:
         return np.load(path)
 
-    def commit(self, tag: str) -> bool:
+    def flush(self) -> None:
         self._queue.join()
-        if self._error is not None:
-            raise RuntimeError(f"async checkpoint writer failed: {self._error}")
+        self._raise_pending()
+
+    def commit(self, tag: str) -> bool:
+        self.flush()
         return True
 
     def close(self):

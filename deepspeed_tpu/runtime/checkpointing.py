@@ -15,12 +15,25 @@ Sharded leaves stream device shard -> memmap'd .npy directly (host RAM peaks
 at one SHARD, not one leaf); loads mmap the file so each target shard reads
 only its pages.  The rank-0 full-gather spike the reference's universal
 checkpoint works around never happens.
+
+Durability protocol (reference checkpoint_engine tag-commit semantics +
+the Orbax/CheckFreq temp-dir-then-rename shape): a save stages everything
+under ``<dir>/.tmp_<tag>/``, fsyncs each leaf, records per-leaf CRC32 +
+byte size in ``metadata.json``, atomically renames the staging dir to
+``<dir>/<tag>/``, calls ``engine.commit(tag)``, and only then flips
+``latest``.  A preemption at ANY point leaves ``latest`` pointing at the
+previous complete checkpoint; stale staging dirs are swept on the next
+save.  Loads validate manifest completeness + sizes (checksums with
+``verify_integrity``) and can fall back to the newest valid prior tag.
 """
 
 import json
 import os
 import re
-from typing import Any, Dict, Optional, Tuple
+import shutil
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -30,6 +43,18 @@ from ..utils.logging import log_dist, logger
 from .checkpoint_engine import CheckpointEngine, NativeCheckpointEngine
 
 LATEST_FILE = "latest"
+INDEX_FILE = "checkpoint_index.json"
+METADATA_FILE = "metadata.json"
+TMP_PREFIX = ".tmp_"
+FORMAT_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint is missing, incomplete, or corrupt.
+
+    Raised instead of the raw ``FileNotFoundError`` / ``JSONDecodeError`` soup a
+    half-written directory produces, always naming the dir, the tag, and a
+    remedy (``fallback_to_valid=True`` walks back to the newest valid tag)."""
 
 
 def _leaf_key(path) -> str:
@@ -54,34 +79,352 @@ def _is_rank0() -> bool:
         return True
 
 
+# ------------------------------------------------------------ durable-IO utils
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # fs without directory fds (or non-POSIX); rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """Stage + fsync + rename so readers never observe a partial file."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+# staging dirs of saves currently in flight in THIS process: a reentrant save
+# (the SIGTERM preemption handler interrupting a regular save) must not sweep
+# the dir the interrupted save is still writing into
+_ACTIVE_STAGING: set = set()
+
+
+def _sweep_stale_tmp(save_dir: str) -> List[str]:
+    """Remove ``.tmp_*`` staging dirs left by crashed saves (safe: a staging
+    dir is only ever renamed away on success, so any survivor not registered
+    as in-flight is garbage)."""
+    swept = []
+    try:
+        entries = os.listdir(save_dir)
+    except OSError:
+        return swept
+    for name in entries:
+        path = os.path.join(save_dir, name)
+        if (name.startswith(TMP_PREFIX) and os.path.isdir(path)
+                and path not in _ACTIVE_STAGING):
+            shutil.rmtree(path, ignore_errors=True)
+            swept.append(name)
+    if swept:
+        logger.warning(f"swept {len(swept)} stale checkpoint staging dir(s) in "
+                       f"{save_dir}: {swept} (crashed earlier save)")
+    return swept
+
+
+# -------------------------------------------------------------- tag bookkeeping
+def _read_index(save_dir: str) -> List[str]:
+    path = os.path.join(save_dir, INDEX_FILE)
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+        tags = data.get("tags", [])
+        return [t for t in tags if isinstance(t, str)]
+    except (OSError, ValueError):
+        return []
+
+
+def _write_index(save_dir: str, tags: List[str]) -> None:
+    _atomic_write_text(os.path.join(save_dir, INDEX_FILE),
+                       json.dumps({"tags": tags}, indent=1))
+
+
+def _append_index(save_dir: str, tag: str) -> None:
+    tags = [t for t in _read_index(save_dir) if t != tag]
+    tags.append(tag)
+    _write_index(save_dir, tags)
+
+
+def list_tags(load_dir: str) -> List[str]:
+    """All checkpoint tags under ``load_dir``, oldest -> newest.  Ordered by
+    ``checkpoint_index.json`` (append-per-save), with any on-disk tags the
+    index missed (e.g. hand-copied) appended in mtime order."""
+    try:
+        on_disk = {d for d in os.listdir(load_dir)
+                   if os.path.isdir(os.path.join(load_dir, d)) and not d.startswith(TMP_PREFIX)}
+    except OSError:
+        return []
+    tags = [t for t in _read_index(load_dir) if t in on_disk]
+    extra = sorted(on_disk - set(tags),
+                   key=lambda t: os.path.getmtime(os.path.join(load_dir, t)))
+    return tags + extra
+
+
+def get_latest_tag(load_dir: str) -> Optional[str]:
+    """The tag named by the ``latest`` file; None when no ``latest`` exists.
+    An empty/whitespace ``latest`` (torn write on a non-atomic fs, or manual
+    truncation) raises :class:`CheckpointError` instead of surfacing later as
+    a confusing missing-dir error."""
+    path = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        tag = fh.read().strip()
+    if not tag:
+        raise CheckpointError(
+            f"checkpoint dir {load_dir!r}: the '{LATEST_FILE}' file is empty/whitespace "
+            f"(torn write?) — delete it and pass an explicit tag, or use "
+            f"load_checkpoint(..., fallback_to_valid=True) to walk back to the newest "
+            f"valid checkpoint")
+    return tag
+
+
+def read_metadata(ckpt_dir: str) -> Dict[str, Any]:
+    """Parse ``<ckpt_dir>/metadata.json``; missing/corrupt JSON raises a
+    :class:`CheckpointError` naming the dir and the remedy."""
+    path = os.path.join(ckpt_dir, METADATA_FILE)
+    if not os.path.exists(path):
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir!r} has no {METADATA_FILE} — incomplete or corrupted "
+            f"save; pick another tag or use fallback_to_valid=True")
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except ValueError as exc:
+        raise CheckpointError(
+            f"checkpoint {ckpt_dir!r}: {METADATA_FILE} is not valid JSON ({exc}) — "
+            f"corrupted save; pick another tag or use fallback_to_valid=True") from exc
+
+
+def check_checkpoint_tag(load_dir: str, tag: str, verify_integrity: bool = False) -> List[str]:
+    """Integrity problems for ``<load_dir>/<tag>/`` (empty list == valid).
+
+    Always checks: tag dir exists, metadata parses, every manifest leaf file
+    exists with the recorded byte size.  With ``verify_integrity`` also
+    re-computes each leaf's CRC32 against the manifest (full read)."""
+    ckpt_dir = os.path.join(load_dir, tag)
+    if not os.path.isdir(ckpt_dir):
+        return [f"tag dir {ckpt_dir} does not exist"]
+    try:
+        meta = read_metadata(ckpt_dir)
+    except CheckpointError as exc:
+        return [str(exc)]
+    problems = []
+    manifest = meta.get("manifest", [])
+    if not isinstance(manifest, list):
+        return [f"metadata manifest is {type(manifest).__name__}, not a list"]
+    for i, entry in enumerate(manifest):
+        key = entry.get("key") if isinstance(entry, dict) else None
+        if not isinstance(key, str):
+            # still-valid-JSON damage must read as "tag invalid", not KeyError
+            # out of the fallback walk this check protects
+            problems.append(f"manifest entry {i} is malformed (no 'key')")
+            continue
+        path = os.path.join(ckpt_dir, key + ".npy")
+        if not os.path.exists(path):
+            problems.append(f"leaf {key}: file missing")
+            continue
+        want_bytes = entry.get("nbytes")
+        if want_bytes is not None and os.path.getsize(path) != want_bytes:
+            problems.append(f"leaf {key}: size {os.path.getsize(path)} != manifest {want_bytes}")
+            continue
+        if verify_integrity and entry.get("crc32") is not None:
+            got = _file_crc32(path)
+            if got != entry["crc32"]:
+                problems.append(f"leaf {key}: crc32 {got:#010x} != manifest {entry['crc32']:#010x}")
+    return problems
+
+
+def validate_checkpoint_tag(load_dir: str, tag: str, verify_integrity: bool = False) -> None:
+    problems = check_checkpoint_tag(load_dir, tag, verify_integrity=verify_integrity)
+    if problems:
+        raise CheckpointError(
+            f"checkpoint {load_dir!r} tag {tag!r} failed validation: "
+            + "; ".join(problems)
+            + " — pass another tag or load_checkpoint(..., fallback_to_valid=True)")
+
+
+def is_valid_tag(load_dir: str, tag: str, verify_integrity: bool = False) -> bool:
+    return not check_checkpoint_tag(load_dir, tag, verify_integrity=verify_integrity)
+
+
+def find_latest_valid_tag(load_dir: str, verify_integrity: bool = False,
+                          exclude: Tuple[str, ...] = ()) -> Optional[str]:
+    """Newest tag (per the checkpoint index / mtime order) that passes
+    validation; the resume-from-latest-valid walk."""
+    for tag in reversed(list_tags(load_dir)):
+        if tag in exclude:
+            continue
+        if is_valid_tag(load_dir, tag, verify_integrity=verify_integrity):
+            return tag
+    return None
+
+
+# -------------------------------------------------------------------- retention
+def sweep_retention(save_dir: str, keep_last_n: Optional[int],
+                    verify_integrity: bool = False) -> List[str]:
+    """Delete tags older than the newest ``keep_last_n`` (checkpoint GC,
+    reference Nebula ``num_of_version_in_retention``).  Never deletes the tag
+    ``latest`` points at, and never deletes the only VALID checkpoint: when
+    everything inside the retention window is corrupt, the newest valid tag
+    outside it is retained so a fallback load always has somewhere to land."""
+    if not keep_last_n or keep_last_n < 1:
+        return []
+    tags = list_tags(save_dir)
+    if len(tags) <= keep_last_n:
+        return []
+    keep = set(tags[-keep_last_n:])
+    try:
+        latest = get_latest_tag(save_dir)
+    except CheckpointError:
+        latest = None
+    if latest is not None:
+        keep.add(latest)
+    if not any(is_valid_tag(save_dir, t, verify_integrity) for t in keep):
+        newest_valid = find_latest_valid_tag(save_dir, verify_integrity)
+        if newest_valid is not None:
+            keep.add(newest_valid)
+    deleted = []
+    for tag in tags:
+        if tag in keep:
+            continue
+        shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+        deleted.append(tag)
+    if deleted:
+        _write_index(save_dir, [t for t in tags if t not in set(deleted)])
+        log_dist(f"checkpoint retention: deleted {deleted} (keep_last_n={keep_last_n})",
+                 ranks=[0])
+    return deleted
+
+
+# ------------------------------------------------------------------------- save
 def save_checkpoint_dir(save_dir: str, tag: str, state, client_state: Dict, config=None,
                         engine: Optional[CheckpointEngine] = None):
-    """Write the full state under ``save_dir/tag/`` and update ``latest``."""
+    """Write the full state under ``save_dir/tag/`` and update ``latest``.
+
+    Crash-safe ordering: stage under ``.tmp_<tag>/`` -> fsync leaves -> write
+    manifest (per-leaf CRC32 + nbytes) -> fsync staging dir -> atomic rename to
+    ``<tag>/`` -> ``engine.commit(tag)`` -> flip ``latest``.  Dying at any
+    point leaves ``latest`` on the previous complete checkpoint; the partial
+    staging dir is swept on the next save."""
     engine = engine or NativeCheckpointEngine()
-    ckpt_dir = os.path.join(save_dir, tag)
-    if _is_rank0():
-        engine.makedirs(ckpt_dir)
-    leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
-    manifest = []
-    for path, leaf in leaves_with_path:
-        key = _leaf_key(path)
-        target = os.path.join(ckpt_dir, key + ".npy")
-        if _is_rank0() and _write_leaf_streaming(leaf, target, engine):
-            pass  # shard-streamed straight into the .npy (no full-leaf host copy)
-        else:
-            arr = _gather_to_host(leaf)
-            if _is_rank0():
-                engine.save(arr, target)
-        dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
-        manifest.append({"key": key, "shape": list(np.shape(leaf)), "dtype": str(dtype)})
-    engine.commit(tag)
-    if _is_rank0():
-        meta = {"manifest": manifest, "client_state": _jsonable(client_state)}
-        with open(os.path.join(ckpt_dir, "metadata.json"), "w") as fh:
-            json.dump(meta, fh, indent=1)
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as fh:
-            fh.write(tag)
-    log_dist(f"saved checkpoint {tag} -> {ckpt_dir} ({len(manifest)} leaves)", ranks=[0])
+    rank0 = _is_rank0()
+    final_dir = os.path.join(save_dir, tag)
+    tmp_dir = os.path.join(save_dir, TMP_PREFIX + tag)
+    _ACTIVE_STAGING.add(tmp_dir)
+    try:
+        if rank0:
+            _sweep_stale_tmp(save_dir)
+            if os.path.isdir(tmp_dir):  # earlier attempt of THIS save (retry)
+                shutil.rmtree(tmp_dir)
+            engine.makedirs(tmp_dir)
+        leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
+        manifest = []
+        for path, leaf in leaves_with_path:
+            key = _leaf_key(path)
+            target = os.path.join(tmp_dir, key + ".npy")
+            if rank0 and _write_leaf_streaming(leaf, target, engine):
+                pass  # shard-streamed straight into the .npy (no full-leaf host copy)
+            else:
+                arr = _gather_to_host(leaf)
+                if rank0:
+                    engine.save(arr, target)
+            dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+            manifest.append({"key": key, "shape": list(np.shape(leaf)), "dtype": str(dtype)})
+        # all leaf bytes must be durable BEFORE the manifest describes them and
+        # the rename publishes them (async engines drain their writer queue here)
+        engine.flush()
+        replaced = None
+        if rank0:
+            for entry in manifest:
+                leaf_path = os.path.join(tmp_dir, entry["key"] + ".npy")
+                _fsync_file(leaf_path)
+                entry["nbytes"] = os.path.getsize(leaf_path)
+                # CRC of the file as it landed on disk: the read-back costs one
+                # extra pass over hot page cache, and is both engine-agnostic
+                # (plug-ins may write any format) and an immediate write
+                # verification — a torn/bitflipped write is caught NOW, not at
+                # the next resume
+                entry["crc32"] = _file_crc32(leaf_path)
+            meta = {"format_version": FORMAT_VERSION, "tag": tag,
+                    "manifest": manifest, "client_state": _jsonable(client_state)}
+            _atomic_write_text(os.path.join(tmp_dir, METADATA_FILE), json.dumps(meta, indent=1))
+            _fsync_dir(tmp_dir)
+            if os.path.isdir(final_dir):
+                # re-saving an existing tag: park the old copy under a VALID tag
+                # name (not .tmp_ — it must stay loadable) so a crash between
+                # the two renames still leaves a complete checkpoint for the
+                # fallback walk; removed only after `latest` flips
+                replaced = final_dir + ".prev"
+                if os.path.isdir(replaced):
+                    shutil.rmtree(replaced)
+                os.rename(final_dir, replaced)
+            os.rename(tmp_dir, final_dir)
+            _fsync_dir(save_dir)
+        # commit AFTER the rename: the tag a plug-in engine marks durable now
+        # names a complete, manifest-bearing directory (the old ordering
+        # committed a tag whose metadata.json did not exist yet)
+        engine.commit(tag)
+        if rank0:
+            _append_index(save_dir, tag)
+            _atomic_write_text(os.path.join(save_dir, LATEST_FILE), tag)
+            if replaced is not None:
+                shutil.rmtree(replaced, ignore_errors=True)
+        log_dist(f"saved checkpoint {tag} -> {final_dir} ({len(manifest)} leaves)", ranks=[0])
+    finally:
+        _ACTIVE_STAGING.discard(tmp_dir)
+
+
+def save_checkpoint_with_retries(save_dir: str, tag: str, state, client_state: Dict,
+                                 config=None, engine: Optional[CheckpointEngine] = None,
+                                 retries: int = 0, backoff_secs: float = 0.5,
+                                 on_retry=None):
+    """``save_checkpoint_dir`` wrapped in bounded exponential-backoff retries
+    over transient ``OSError`` (flaky NFS/GCS fuse mounts).  Non-OSError
+    failures — including a simulated crash from the fault harness — propagate
+    immediately: retrying a logic error never helps."""
+    attempts = max(int(retries), 0) + 1
+    for attempt in range(attempts):
+        try:
+            return save_checkpoint_dir(save_dir, tag, state, client_state,
+                                       config=config, engine=engine)
+        except OSError as exc:
+            if attempt + 1 >= attempts:
+                raise
+            delay = backoff_secs * (2 ** attempt)
+            logger.warning(f"checkpoint save {tag} attempt {attempt + 1}/{attempts} "
+                           f"failed ({exc!r}); retrying in {delay:.2f}s")
+            if on_retry is not None:
+                on_retry(attempt + 1, exc)
+            if delay > 0:
+                time.sleep(delay)
 
 
 def _gather_to_host(leaf) -> np.ndarray:
@@ -89,6 +432,19 @@ def _gather_to_host(leaf) -> np.ndarray:
         rep = NamedSharding(leaf.sharding.mesh, PartitionSpec())
         leaf = jax.device_put(leaf, rep)
     return np.asarray(leaf)
+
+
+def _leaf_fully_addressable(leaf) -> bool:
+    """Seam for the multi-host tests: this process can see every shard."""
+    return leaf.is_fully_addressable
+
+
+def _shard_index_key(index) -> tuple:
+    """Hashable form of ``shard.index`` (a tuple of slices — unhashable before
+    Python 3.12, which made the dedup set below throw and silently demote
+    EVERY streaming save to the full-gather path)."""
+    return tuple((s.start, s.stop, s.step) if isinstance(s, slice) else s
+                 for s in index)
 
 
 def _write_leaf_streaming(leaf, target: str, engine) -> bool:
@@ -100,7 +456,7 @@ def _write_leaf_streaming(leaf, target: str, engine) -> bool:
     (fallback: gather + engine.save)."""
     if not isinstance(leaf, jax.Array) or len(leaf.sharding.device_set) <= 1:
         return False
-    if not leaf.is_fully_addressable:
+    if not _leaf_fully_addressable(leaf):
         # multi-host: this process can't see every shard — writing only local
         # shards would persist zeros for the rest, and skipping the gather on
         # rank 0 while others enter it would desync the collective.  All ranks
@@ -113,9 +469,10 @@ def _write_leaf_streaming(leaf, target: str, engine) -> bool:
                                         shape=leaf.shape)
         seen = set()
         for shard in leaf.addressable_shards:
-            if shard.index in seen:  # replicated-over-axis shards write once
+            key = _shard_index_key(shard.index)
+            if key in seen:  # replicated-over-axis shards write once
                 continue
-            seen.add(shard.index)
+            seen.add(key)
             out[shard.index] = np.asarray(shard.data)
         out.flush()
         del out
@@ -127,39 +484,52 @@ def _write_leaf_streaming(leaf, target: str, engine) -> bool:
 
 
 def _jsonable(obj):
+    """JSON-safe deep copy of client_state: numpy/jax leaves become lists or
+    Python scalars (an ``np.bool_`` or a device array in client_state used to
+    raise TypeError deep inside json.dump, torching the whole save)."""
     if isinstance(obj, dict):
         return {k: _jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]
-    if isinstance(obj, (np.integer, np.floating)):
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        return np.asarray(obj).tolist()
+    if isinstance(obj, np.generic):  # np.bool_ / np.integer / np.floating / ...
         return obj.item()
-    return obj
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    logger.warning(f"client_state value of type {type(obj).__name__} is not "
+                   f"JSON-serializable; storing str() representation")
+    return str(obj)
 
 
-def get_latest_tag(load_dir: str) -> Optional[str]:
-    path = os.path.join(load_dir, LATEST_FILE)
-    if not os.path.exists(path):
-        return None
-    with open(path) as fh:
-        return fh.read().strip()
-
-
+# ------------------------------------------------------------------------- load
 def load_checkpoint_dir(load_dir: str,
                         tag: Optional[str],
                         state_template,
                         target_shardings,
-                        load_optimizer_states: bool = True) -> Tuple[Any, Dict]:
+                        load_optimizer_states: bool = True,
+                        verify_integrity: bool = False,
+                        validate: bool = True) -> Tuple[Any, Dict]:
     """Rebuild a train state from disk, placing each leaf with the current plan's
     sharding (elastic/reshaping load).  ``state_template`` supplies the pytree
     structure; ``load_optimizer_states=False`` keeps the template's optimizer
     state/loss scale and loads only params+step (reference load_checkpoint:2688
-    ``load_optimizer_states`` arg)."""
+    ``load_optimizer_states`` arg).
+
+    The tag is validated first (manifest completeness + byte sizes, CRC32s too
+    with ``verify_integrity``); an incomplete/corrupt tag raises
+    :class:`CheckpointError` before any leaf is touched.  Callers that already
+    validated (the engine's fallback-tag resolution) pass ``validate=False`` so
+    a CRC pass over a multi-GB checkpoint isn't paid twice per resume."""
     tag = tag or get_latest_tag(load_dir)
     if tag is None:
-        raise FileNotFoundError(f"no 'latest' file in {load_dir} and no tag given")
+        raise CheckpointError(
+            f"checkpoint dir {load_dir!r} has no '{LATEST_FILE}' file and no tag was "
+            f"given — nothing to resume from; pass an explicit tag or save first")
+    if validate:
+        validate_checkpoint_tag(load_dir, tag, verify_integrity=verify_integrity)
     ckpt_dir = os.path.join(load_dir, tag)
-    with open(os.path.join(ckpt_dir, "metadata.json")) as fh:
-        meta = json.load(fh)
+    meta = read_metadata(ckpt_dir)
     available = {m["key"] for m in meta["manifest"]}
 
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(state_template)

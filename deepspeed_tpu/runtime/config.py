@@ -332,7 +332,16 @@ class CheckpointSectionConfig(ConfigModel):
     ``get_checkpoint_params``) plus engine selection — the reference picks the
     Nebula async engine vs torch from config in ``_configure_checkpointing``
     (runtime/engine.py:921).  ``checkpoint_engine`` here selects the plug-in
-    built by runtime/checkpoint_engine.build_checkpoint_engine."""
+    built by runtime/checkpoint_engine.build_checkpoint_engine.
+
+    Resilience knobs (runtime/checkpointing.py durability protocol):
+    ``keep_last_n`` GCs tags beyond the newest N after each save (the newest
+    VALID tag is never deleted); ``verify_integrity`` re-checks each leaf's
+    CRC32 against the manifest at load; ``save_retries``/``retry_backoff_secs``
+    bound the exponential-backoff retry loop around transient save OSErrors;
+    ``save_on_preemption`` installs a SIGTERM handler that performs one final
+    best-effort save (tag ``preempt_step<N>``, ``client_state.preempted``
+    true) before the process dies."""
     allow_extra = True
     checkpoint_engine: str = Field("native", choices=("native", "torch", "async", "nebula"))
     async_max_queue: int = Field(64, ge=1)
@@ -340,6 +349,11 @@ class CheckpointSectionConfig(ConfigModel):
                                                          "ignore", "warn", "fail"))
     use_node_local_storage: bool = False
     parallel_write: Optional[Dict[str, Any]] = None
+    keep_last_n: Optional[int] = Field(None, ge=1)
+    verify_integrity: bool = False
+    save_retries: int = Field(2, ge=0)
+    retry_backoff_secs: float = Field(0.5, ge=0.0)
+    save_on_preemption: bool = False
 
 
 class NebulaConfig(ConfigModel):
@@ -446,6 +460,12 @@ class TrainingConfig(ConfigModel):
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
+    # train-loop watchdog: abort after this many CONSECUTIVE bad steps — fp16
+    # overflow-skips, or non-finite loss/grad-norm on bf16/fp32 (which have no
+    # overflow-skip and would otherwise silently train on NaNs forever).
+    # 0 disables; enabling adds one host value-fetch (device sync) per step
+    # when telemetry/wall_clock_breakdown haven't already paid it.
+    max_consecutive_skips: int = Field(0, ge=0)
     dump_state: bool = False
     checkpoint_tag_validation: str = Field("Warn", choices=("Ignore", "Warn", "Fail", "ignore", "warn", "fail"))
     load_universal_checkpoint: bool = False

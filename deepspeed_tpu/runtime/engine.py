@@ -31,12 +31,20 @@ from ..utils.logging import log_dist, logger
 from ..utils.memory import see_memory_usage
 from ..utils.timer import ThroughputTimer
 from . import lr_schedules, optimizers
-from .checkpointing import load_checkpoint_dir, save_checkpoint_dir
+from .checkpointing import (CheckpointError, _is_rank0, find_latest_valid_tag,
+                            load_checkpoint_dir, save_checkpoint_with_retries,
+                            sweep_retention, validate_checkpoint_tag)
 from .grad_accum import accumulate_micro_grads
 from .config import TrainingConfig, load_config
 from .optimizers import (LossScaleState, clip_by_global_norm, global_grad_norm, has_overflow, init_loss_scale,
                          update_loss_scale)
 from .zero.sharding import ShardingPlan, build_sharding_plan
+
+
+class NonFiniteLossError(RuntimeError):
+    """The train-loop watchdog tripped: ``max_consecutive_skips`` successive
+    steps produced a non-finite loss/grad-norm (bf16/fp32) or overflow-skipped
+    (fp16) — the run is diverged and further steps only burn accelerator time."""
 
 
 class TrainState(NamedTuple):
@@ -150,6 +158,13 @@ class Engine:
         self._compiled_step = None
         self._compiled_eval = None
         self._ckpt_engine = None  # built lazily from config (checkpoint/nebula)
+        self._consecutive_bad_steps = 0  # NaN/overflow watchdog counter
+        # preemption (SIGTERM) best-effort final save: armed on the first
+        # save_checkpoint() when checkpoint.save_on_preemption is set
+        self._preempt_save_dir: Optional[str] = None
+        self._preempt_prev_handler = None
+        self._preempt_registered = False
+        self._in_preempt_save = False
 
         act_cfg = config.activation_checkpointing
         if act_cfg.cpu_checkpointing or act_cfg.policy != "nothing_saveable":
@@ -679,6 +694,7 @@ class Engine:
                     step=self.global_steps, samples=self.global_samples,
                     loss=float(loss), grad_norm=0.0, lr=lr, step_time_s=step_time,
                     tokens=self._batch_tokens(batch, seq_dim=1))
+            self._watchdog_check(metrics, loss_val=float(loss))
             self._maybe_report(metrics)
             return metrics
         if self._ltd_state is not None:
@@ -748,6 +764,7 @@ class Engine:
             # memory_breakdown stands alone: the reference's top-level key must
             # snapshot even when per-step telemetry records are off
             see_memory_usage(f"after train step {self.global_steps}")
+        self._watchdog_check(metrics, loss_val=loss_val)
         self._maybe_report(metrics, loss=loss_val)
         return metrics
 
@@ -857,6 +874,45 @@ class Engine:
             ("Eval/batch_time_ms", (time.perf_counter() - t0) * 1e3, self.global_samples)])
         return loss
 
+    # ----------------------------------------------------------- watchdog
+    def _watchdog_check(self, metrics: StepMetrics, loss_val: Optional[float] = None):
+        """NaN/Inf sentinel (``max_consecutive_skips`` config): fp16 runs count
+        consecutive overflow-SKIPPED steps (the loss scaler absorbs isolated
+        spikes, but an unbroken skip streak means the scale can't find footing);
+        bf16/fp32 runs — which have no skip path — count consecutive non-finite
+        losses/grad-norms.  One good step resets the streak; hitting the limit
+        raises :class:`NonFiniteLossError` with a diagnostic instead of letting
+        the run silently train on garbage until the job deadline."""
+        limit = self.config.max_consecutive_skips
+        if limit <= 0:
+            return
+        if self.fp16_enabled:
+            bad = bool(metrics.skipped)
+            grad_norm = None
+        else:
+            if loss_val is None:
+                loss_val = float(metrics.loss)
+            grad_norm = float(metrics.grad_norm)
+            bad = not (np.isfinite(loss_val) and np.isfinite(grad_norm))
+        if not bad:
+            self._consecutive_bad_steps = 0
+            return
+        self._consecutive_bad_steps += 1
+        self.telemetry.record_resilience(
+            "watchdog_nonfinite", step=self.global_steps, samples=self.global_samples,
+            consecutive=self._consecutive_bad_steps, limit=limit,
+            loss=loss_val, grad_norm=grad_norm)
+        if self._consecutive_bad_steps >= limit:
+            kind = ("fp16 overflow-skipped" if self.fp16_enabled
+                    else "non-finite loss/grad-norm")
+            raise NonFiniteLossError(
+                f"train-loop watchdog: {self._consecutive_bad_steps} consecutive "
+                f"{kind} steps (max_consecutive_skips={limit}) at global step "
+                f"{self.global_steps} — last loss={loss_val}, grad_norm={grad_norm}, "
+                f"lr={float(metrics.lr):.3e}. The run has diverged: check the data "
+                f"pipeline for corrupt batches, lower the lr, or resume from the "
+                f"last checkpoint with load_checkpoint(fallback_to_valid=True)")
+
     # ----------------------------------------------------------- reporting
     def _maybe_report(self, metrics: StepMetrics, loss: Optional[float] = None):
         if self.global_steps % self.config.steps_per_print == 0:
@@ -947,13 +1003,69 @@ class Engine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         state = self.state if self.offload_device is None else self._offload_host_state()
+        ck = self.config.checkpoint
         t0 = time.perf_counter()
         with self.telemetry.annotation("checkpoint_save"):
-            save_checkpoint_dir(save_dir, tag, state, client_state, config=self.config,
-                                engine=self.checkpoint_engine)
+            save_checkpoint_with_retries(
+                save_dir, tag, state, client_state, config=self.config,
+                engine=self.checkpoint_engine,
+                retries=ck.save_retries, backoff_secs=ck.retry_backoff_secs,
+                on_retry=lambda attempt, exc: self.telemetry.record_resilience(
+                    "save_retry", step=self.global_steps, samples=self.global_samples,
+                    tag=tag, attempt=attempt, error=repr(exc)))
         self.telemetry.record_events([("Train/Checkpoint/save_time_ms",
                                        (time.perf_counter() - t0) * 1e3, self.global_samples)])
+        if ck.keep_last_n and _is_rank0():
+            sweep_retention(save_dir, ck.keep_last_n, verify_integrity=ck.verify_integrity)
+        self._register_preemption_handler(save_dir)
         return tag
+
+    # ----------------------------------------------- preemption (SIGTERM) save
+    def _register_preemption_handler(self, save_dir: str):
+        """Arm the best-effort final save (``checkpoint.save_on_preemption``):
+        on SIGTERM — the TPU-pod preemption notice — save one last checkpoint
+        tagged ``preempt_step<N>`` with ``client_state.preempted`` set, then
+        chain to whatever handler was installed before (so the default
+        die-on-TERM still happens in production)."""
+        self._preempt_save_dir = save_dir
+        if self._preempt_registered or not self.config.checkpoint.save_on_preemption:
+            return
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return  # signal.signal only works from the main thread
+        try:
+            self._preempt_prev_handler = signal.signal(signal.SIGTERM, self._on_preemption)
+            self._preempt_registered = True
+            log_dist("checkpoint: save_on_preemption armed (SIGTERM -> final save)",
+                     ranks=[0])
+        except (ValueError, OSError) as exc:
+            logger.warning(f"save_on_preemption: could not install SIGTERM handler ({exc})")
+
+    def _on_preemption(self, signum=None, frame=None):
+        import signal
+        if not self._in_preempt_save and self._preempt_save_dir is not None:
+            self._in_preempt_save = True
+            try:
+                tag = f"preempt_step{self.global_steps}"
+                logger.warning(f"SIGTERM: best-effort preemption save -> "
+                               f"{self._preempt_save_dir}/{tag}")
+                self.save_checkpoint(self._preempt_save_dir, tag=tag,
+                                     client_state={"preempted": True})
+                self.telemetry.record_resilience("preemption_save", step=self.global_steps,
+                                                 samples=self.global_samples, tag=tag)
+            except BaseException as exc:  # best-effort: never mask the signal
+                logger.error(f"preemption save failed: {exc!r}")
+            finally:
+                self._in_preempt_save = False
+        prev = self._preempt_prev_handler
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL and signum is not None:
+            # restore the default disposition and re-deliver so the process
+            # still dies the way the supervisor expects
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
 
     def _offload_host_state(self):
         """Host-side state pytree with the SAME key layout as the on-device
@@ -969,38 +1081,80 @@ class Engine:
         return {"step": np.int32(sd["step"]), "params": params,
                 "opt_state": {"step": np.int32(sd["step"]), "exp_avg": m, "exp_avg_sq": v}}
 
-    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, load_optimizer_states: bool = True):
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_optimizer_states: bool = True, fallback_to_valid: bool = False):
+        """Resume from ``load_dir``.  With ``fallback_to_valid`` a missing,
+        incomplete, or corrupt target tag (per manifest sizes, plus CRC32s when
+        ``checkpoint.verify_integrity`` is on) doesn't raise: the load walks
+        prior tags — checkpoint-index order, newest first — to the newest one
+        that validates (resume-from-latest-valid)."""
         self._nvme_guard("load_checkpoint")
         t0 = time.perf_counter()
         with self.telemetry.annotation("checkpoint_load"):
             if self.config.load_universal_checkpoint:
                 out = self._load_universal_checkpoint(load_dir, tag, load_optimizer_states)
-            elif self.offload_device is not None:
-                out = self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
             else:
-                state, client_state = load_checkpoint_dir(
-                    load_dir,
-                    tag,
-                    self.state,
-                    self._state_shardings(jax.eval_shape(lambda s: s, self.state)),
-                    load_optimizer_states=load_optimizer_states)
-                self.state = state
-                self.global_steps = client_state.get("global_steps", 0)
-                self.global_samples = client_state.get("global_samples", 0)
-                if "lr_scheduler" in client_state:
-                    self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
-                out = (tag, client_state)
+                tag = self._resolve_load_tag(load_dir, tag, fallback_to_valid)
+                if self.offload_device is not None:
+                    out = self._load_checkpoint_offload(load_dir, tag, load_optimizer_states)
+                else:
+                    state, client_state = load_checkpoint_dir(
+                        load_dir,
+                        tag,
+                        self.state,
+                        self._state_shardings(jax.eval_shape(lambda s: s, self.state)),
+                        load_optimizer_states=load_optimizer_states,
+                        # _resolve_load_tag just validated this tag (CRCs per
+                        # checkpoint.verify_integrity); don't pay it twice
+                        validate=False)
+                    self.state = state
+                    self.global_steps = client_state.get("global_steps", 0)
+                    self.global_samples = client_state.get("global_samples", 0)
+                    if "lr_scheduler" in client_state:
+                        self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+                    out = (tag, client_state)
         self.telemetry.record_events([("Train/Checkpoint/load_time_ms",
                                        (time.perf_counter() - t0) * 1e3, self.global_samples)])
         return out
 
-    def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True):
+    def _resolve_load_tag(self, load_dir: str, tag: Optional[str],
+                          fallback_to_valid: bool) -> str:
+        """Pick the tag to load: the requested one (or ``latest``) when it
+        validates; otherwise — only with ``fallback_to_valid`` — the newest
+        prior tag that does."""
         from .checkpointing import get_latest_tag
-        import json as _json
+        verify = self.config.checkpoint.verify_integrity
+        requested, failure = tag, None
+        try:
+            requested = tag or get_latest_tag(load_dir)
+            if requested is None:
+                raise CheckpointError(
+                    f"checkpoint dir {load_dir!r} has no 'latest' file and no tag was "
+                    f"given — nothing to resume from")
+            validate_checkpoint_tag(load_dir, requested, verify_integrity=verify)
+            return requested
+        except CheckpointError as exc:
+            if not fallback_to_valid:
+                raise
+            failure = exc
+        exclude = (requested, ) if requested else ()
+        found = find_latest_valid_tag(load_dir, verify_integrity=verify, exclude=exclude)
+        if found is None:
+            raise CheckpointError(
+                f"checkpoint dir {load_dir!r}: no valid checkpoint to fall back to "
+                f"(requested tag {requested!r} failed: {failure})")
+        logger.warning(f"checkpoint tag {requested!r} is unusable ({failure}); "
+                       f"falling back to newest valid tag {found!r}")
+        self.telemetry.record_resilience(
+            "fallback_load", step=self.global_steps, samples=self.global_samples,
+            requested=str(requested), fallback=found, reason=str(failure))
+        return found
+
+    def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True):
+        from .checkpointing import get_latest_tag, read_metadata
         tag = tag or get_latest_tag(load_dir)
         ckpt_dir = os.path.join(load_dir, tag)
-        with open(os.path.join(ckpt_dir, "metadata.json")) as fh:
-            meta = _json.load(fh)
+        meta = read_metadata(ckpt_dir)
         sd = {"m": {}, "v": {}, "step": 0}
         for m in meta["manifest"]:
             key = m["key"]

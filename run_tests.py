@@ -85,6 +85,79 @@ def telemetry_smoke():
     return 0
 
 
+def resilience_smoke():
+    """CI smoke for the checkpoint resilience layer (ISSUE 2 acceptance):
+    kill a save mid-write, prove ``latest`` still names the previous complete
+    checkpoint, resume a FRESH engine from it with fallback_to_valid, and
+    verify loss continuity — three post-resume steps reproduce the original
+    run's losses exactly (fp32)."""
+    import os
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.checkpointing import TMP_PREFIX, get_latest_tag, is_valid_tag
+    from tests.unit.fault_injection import FaultyCheckpointEngine, SimulatedCrash
+    from tests.unit.simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+    hidden = 16
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},  # fp32: exact loss continuity
+        "steps_per_print": 100,
+        "checkpoint": {"save_retries": 2, "retry_backoff_secs": 0.0},
+    }
+
+    def build():
+        params = init_mlp_params(jax.random.PRNGKey(0), hidden=hidden)
+        engine, _, _, _ = deepspeed_tpu.initialize(loss_fn=mlp_loss_fn,
+                                                   model_parameters=params, config=config)
+        return engine
+
+    def step(engine, seed):
+        batch = random_batch(engine.train_batch_size, hidden=hidden, seed=seed)
+        return float(engine.train_batch(batch).loss)
+
+    ckdir = tempfile.mkdtemp(prefix="dstpu_resilience_smoke_")
+    engine = build()
+    for s in range(3):
+        step(engine, seed=s)
+    good_tag = engine.save_checkpoint(ckdir)
+    ref_losses = [step(engine, seed=100 + s) for s in range(3)]
+
+    # preemption strikes the next save mid-write
+    engine._ckpt_engine = FaultyCheckpointEngine(kill_after_bytes=1500)
+    crashed = False
+    try:
+        engine.save_checkpoint(ckdir, tag="doomed")
+    except SimulatedCrash:
+        crashed = True
+    assert crashed, "fault injection did not fire"
+    assert get_latest_tag(ckdir) == good_tag, "crashed save moved 'latest'"
+    assert not os.path.isdir(os.path.join(ckdir, "doomed")), "partial tag was published"
+    assert is_valid_tag(ckdir, good_tag, verify_integrity=True)
+
+    # a fresh process resumes from the intact checkpoint and replays identically
+    engine2 = build()
+    loaded_tag, _ = engine2.load_checkpoint(ckdir, fallback_to_valid=True)
+    assert loaded_tag == good_tag, f"resumed from {loaded_tag!r}, wanted {good_tag!r}"
+    resumed_losses = [step(engine2, seed=100 + s) for s in range(3)]
+    np.testing.assert_allclose(resumed_losses, ref_losses, rtol=0, atol=0)
+
+    # the next healthy save sweeps the crashed staging dir
+    engine2.save_checkpoint(ckdir)
+    stale = [d for d in os.listdir(ckdir) if d.startswith(TMP_PREFIX)]
+    assert not stale, f"staging dirs not swept: {stale}"
+
+    print(json.dumps({"resilience_smoke": "ok", "good_tag": good_tag,
+                      "resumed_losses": resumed_losses, "ckdir": ckdir}))
+    return 0
+
+
 def run_lane(name: str, marker_args):
     t0 = time.time()
     proc = subprocess.run([sys.executable, "-m", "pytest", "tests/", "-q", *marker_args],
@@ -112,4 +185,6 @@ def main():
 if __name__ == "__main__":
     if "--telemetry-smoke" in sys.argv:
         sys.exit(telemetry_smoke())
+    if "--resilience-smoke" in sys.argv:
+        sys.exit(resilience_smoke())
     sys.exit(main())

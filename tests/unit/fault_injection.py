@@ -1,0 +1,115 @@
+"""Failpoint-style fault injection for checkpoint resilience tests.
+
+The harness plays the role of a dying host / flaky filesystem at the
+checkpoint-engine seam (every leaf write funnels through
+``CheckpointEngine.save`` once streaming is disabled), plus post-hoc
+corruption helpers for damage that happens AFTER a save completes (bit rot,
+partial deletion).  Used by test_checkpoint_resilience.py and the
+``make resilience-smoke`` CI target to prove the crash-safe save protocol:
+a kill at any point never moves ``latest`` off the previous complete
+checkpoint, and transient IO errors are absorbed by the retry loop.
+"""
+
+import io
+import os
+
+import numpy as np
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import NativeCheckpointEngine
+from deepspeed_tpu.runtime.checkpointing import METADATA_FILE
+
+
+class SimulatedCrash(BaseException):
+    """Simulated process death mid-save.  Deliberately a BaseException: the
+    save retry loop (and any ``except Exception`` cleanup) must NOT absorb it,
+    exactly like a real SIGKILL wouldn't run those handlers."""
+
+
+class FaultyCheckpointEngine(NativeCheckpointEngine):
+    """A checkpoint engine that fails on command.
+
+    ``kill_after_bytes``  — write leaf bytes until the budget runs out, leave
+                            the current file truncated, then raise
+                            :class:`SimulatedCrash` (preemption mid-save).
+    ``kill_after_leaves`` — die cleanly between leaf N and N+1.
+    ``transient_errors``  — raise ``OSError`` for the first N ``save()`` calls,
+                            then behave normally (flaky NFS/GCS mount).
+    ``corrupt_key``       — flip bytes in any leaf whose filename starts with
+                            this key, keeping the byte size (only a CRC check
+                            can catch it).
+    """
+
+    # force every leaf through save() so the failpoints always fire (the
+    # streaming path writes via memmap and would bypass them)
+    supports_streaming_save = False
+
+    def __init__(self, kill_after_bytes=None, kill_after_leaves=None,
+                 transient_errors=0, corrupt_key=None):
+        self.kill_after_bytes = kill_after_bytes
+        self.kill_after_leaves = kill_after_leaves
+        self.transient_errors = int(transient_errors)
+        self.corrupt_key = corrupt_key
+        self.saves_completed = 0
+        self.bytes_written = 0
+        self.transients_raised = 0
+
+    def save(self, arr: np.ndarray, path: str) -> None:
+        if self.transients_raised < self.transient_errors:
+            self.transients_raised += 1
+            raise OSError(f"injected transient IO error "
+                          f"#{self.transients_raised}/{self.transient_errors} ({path})")
+        if (self.kill_after_leaves is not None
+                and self.saves_completed >= self.kill_after_leaves):
+            raise SimulatedCrash(f"killed save before leaf #{self.saves_completed + 1}")
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr))
+        data = buf.getvalue()
+        if (self.kill_after_bytes is not None
+                and self.bytes_written + len(data) > self.kill_after_bytes):
+            budget = max(self.kill_after_bytes - self.bytes_written, 0)
+            with open(path, "wb") as fh:
+                fh.write(data[:budget])  # the truncated file a dying host leaves
+            self.bytes_written += budget
+            raise SimulatedCrash(f"killed save after {self.bytes_written} bytes "
+                                 f"(mid-write of {os.path.basename(path)})")
+        if self.corrupt_key and os.path.basename(path).startswith(self.corrupt_key):
+            data = _flip_tail_bytes(data)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        self.saves_completed += 1
+        self.bytes_written += len(data)
+
+
+def _flip_tail_bytes(data: bytes, n: int = 4) -> bytes:
+    """Invert the last ``n`` bytes (payload, not the .npy header) — same size,
+    different content."""
+    tail = bytes(b ^ 0xFF for b in data[-n:])
+    return data[:-n] + tail
+
+
+# -------------------------------------------------- post-hoc corruption helpers
+def corrupt_leaf(ckpt_dir: str, key: str, n: int = 4) -> str:
+    """Flip payload bytes of ``<ckpt_dir>/<key>.npy`` in place, preserving the
+    file size (detectable only via CRC32 verification)."""
+    path = os.path.join(ckpt_dir, key + ".npy")
+    with open(path, "rb") as fh:
+        data = fh.read()
+    with open(path, "wb") as fh:
+        fh.write(_flip_tail_bytes(data, n))
+    return path
+
+
+def truncate_leaf(ckpt_dir: str, key: str, keep_bytes: int = 64) -> str:
+    """Truncate ``<ckpt_dir>/<key>.npy`` to ``keep_bytes`` (size-check
+    detectable)."""
+    path = os.path.join(ckpt_dir, key + ".npy")
+    os.truncate(path, keep_bytes)
+    return path
+
+
+def drop_metadata(ckpt_dir: str) -> str:
+    """Delete ``metadata.json`` from a finalized tag (external damage; a crash
+    can no longer produce this state since the rename is atomic)."""
+    path = os.path.join(ckpt_dir, METADATA_FILE)
+    os.remove(path)
+    return path
