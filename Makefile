@@ -5,10 +5,21 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# dslint: JAX/TPU-aware static analysis (tools/staticcheck) over the whole
+# package; exits non-zero on any non-baselined finding.  CI gate (also a lane
+# in run_tests.py).
+lint:
+	$(PY) bin/dstpu-lint deepspeed_tpu
+
+# grandfather the current findings (policy: the baseline only ever shrinks —
+# new code suppresses inline with a written reason instead)
+lint-baseline:
+	$(PY) bin/dstpu-lint deepspeed_tpu --update-baseline
 
 test-slow:
 	$(PY) -m pytest tests/ -q -m slow

@@ -24,13 +24,17 @@ class BaseTuner:
     Early-stops after ``early_stopping`` consecutive non-improving trials.
     """
 
-    def __init__(self, exps: List[Experiment], run_fn: RunFn, early_stopping: int = 5):
+    def __init__(self, exps: List[Experiment], run_fn: RunFn, early_stopping: int = 5,
+                 seed: int = 1234):
         self.all_exps = list(exps)
         self.run_fn = run_fn
         self.early_stopping = early_stopping
         self.best_exp: Optional[Experiment] = None
         self.best_metric: float = -float("inf")
         self.records: List[Tuple[Experiment, Optional[float]]] = []
+        # private seeded stream: exploration order is reproducible across
+        # reruns/ranks instead of riding the global `random` module state
+        self._rng = random.Random(seed)
 
     def next_batch(self, remaining: List[Experiment]) -> List[Experiment]:
         raise NotImplementedError
@@ -66,7 +70,7 @@ class RandomTuner(BaseTuner):
     """Uniform random order (reference index_based_tuner.py:14)."""
 
     def next_batch(self, remaining):
-        return [random.choice(remaining)]
+        return [self._rng.choice(remaining)]
 
 
 def _featurize(exps: List[Experiment]):
@@ -88,7 +92,7 @@ def _featurize(exps: List[Experiment]):
     nnum, ncat = len(num_idx), len(cat_idx)
 
     def vec(exp):
-        x = np.zeros(2 * nnum + ncat + 1, dtype=np.float64)
+        x = np.zeros(2 * nnum + ncat + 1, dtype=np.float64)  # dslint: disable=float64-in-compute  # host-only ridge-regression features; never shipped to a device
         for k, v in _flatten(exp).items():
             if k in num_idx:
                 z = float(v) / scales[k]
@@ -125,8 +129,8 @@ class ModelBasedTuner(BaseTuner):
     """
 
     def __init__(self, exps, run_fn, early_stopping: int = 5, num_random: int = 3,
-                 ridge: float = 1e-3):
-        super().__init__(exps, run_fn, early_stopping)
+                 ridge: float = 1e-3, seed: int = 1234):
+        super().__init__(exps, run_fn, early_stopping, seed=seed)
         self.num_random = num_random
         self.ridge = ridge
         self._vec = _featurize(self.all_exps)
@@ -134,7 +138,7 @@ class ModelBasedTuner(BaseTuner):
     def next_batch(self, remaining):
         measured = [(e, m) for e, m in self.records if m is not None]
         if len(measured) < self.num_random:
-            return [random.choice(remaining)]
+            return [self._rng.choice(remaining)]
         X = np.stack([self._vec(e) for e, _ in measured])
         y = np.array([m for _, m in measured])
         n = X.shape[1]

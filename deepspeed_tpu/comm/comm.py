@@ -26,7 +26,7 @@ from jax import lax
 
 from ..parallel.mesh import MeshTopology, get_topology
 from ..utils.comms_logging import get_comms_logger
-from ..utils.logging import logger
+from ..utils.logging import logger, warning_once
 
 ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
 
@@ -113,8 +113,9 @@ def _trace_log(op: str, x) -> None:
     if cl.should_profile(op):
         try:
             cl.record_traced(op, int(np.prod(x.shape)) * x.dtype.itemsize)
-        except Exception:
-            pass
+        except Exception as exc:  # odd operand (no shape/dtype): skip the sample
+            warning_once(f"comms logger: could not size a traced {op} operand "
+                         f"({exc!r}); that collective is missing from the summary")
 
 
 def all_reduce(x, axis: AxisArg, op: str = "sum"):

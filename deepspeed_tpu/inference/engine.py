@@ -172,13 +172,16 @@ class InferenceEngine:
 def _sample(logits, rng, *, temperature, top_k, top_p):
     """Temperature / top-k / top-p sampling on-device; greedy at T=0."""
     logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
+    # temperature/top_k/top_p are Python scalars bound via functools.partial
+    # BEFORE jit at every call site (engine.generate, engine_v2 pick/burst), so
+    # these branches specialize the trace; only logits/rng are traced values
+    if temperature == 0.0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
     logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k and top_k > 0:
+    if top_k and top_k > 0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p < 1.0:
+    if top_p < 1.0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)

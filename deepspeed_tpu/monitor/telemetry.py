@@ -33,7 +33,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..utils.logging import logger
+from ..utils.logging import logger, warning_once
 from ..utils.memory import device_memory_stats
 
 Event = Tuple[str, float, int]
@@ -56,8 +56,10 @@ def detect_peak_flops_per_chip() -> Optional[float]:
     try:
         import jax
         probe += " " + getattr(jax.devices()[0], "device_kind", "")
-    except Exception:
-        pass
+    except Exception as exc:  # no backend: MFU falls back to the config pin
+        warning_once(f"telemetry: device-kind probe failed ({exc!r}); peak FLOPs "
+                     f"detection degrades to the PALLAS_AXON_TPU_GEN env / "
+                     f"telemetry.peak_flops_per_chip config")
     probe = probe.lower().replace("tpu ", "").replace(" lite", "e")
     for gen, peak in PEAK_FLOPS_BY_GEN.items():
         if gen in probe:
@@ -284,5 +286,5 @@ class TelemetryCollector:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # dslint: disable=silent-except  # interpreter-shutdown teardown: logging/profiler may already be torn down, raising from __del__ only prints noise
             pass

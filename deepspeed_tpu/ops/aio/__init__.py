@@ -64,7 +64,7 @@ class AsyncIOHandle:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # dslint: disable=silent-except  # interpreter-shutdown teardown: the ctypes lib may be unloaded already; raising from __del__ only prints noise
             pass
 
 

@@ -21,13 +21,25 @@ import numpy as np
 
 
 class SparsityConfig:
-    """Base class: block size, head count, and per-head layout policy."""
+    """Base class: block size, head count, and per-head layout policy.
 
-    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+    ``seed`` drives every random-block placement through a private
+    ``random.Random`` stream (never the global ``random`` module), so a given
+    config produces the SAME layout on every rank and every rerun — the layout
+    feeds each rank's kernel prefetch tables, and divergent tables would make
+    attention itself rank-dependent."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False, seed=1234):
         self.num_heads = num_heads
         self.block = block
         self.different_layout_per_head = different_layout_per_head
         self.num_layout_heads = num_heads if different_layout_per_head else 1
+        self.seed = seed
+
+    def layout_rng(self):
+        """A fresh seeded stream per make_layout call: layouts are a pure
+        function of (config, seq_len), not of how many were built before."""
+        return random.Random(self.seed)
 
     def setup_layout(self, seq_len):
         if seq_len % self.block != 0:
@@ -157,8 +169,9 @@ class VariableSparsityConfig(SparsityConfig):
     def __init__(self, num_heads, block=16, different_layout_per_head=False,
                  num_random_blocks=0, local_window_blocks=None,
                  global_block_indices=None, global_block_end_indices=None,
-                 attention="bidirectional", horizontal_global_attention=False):
-        super().__init__(num_heads, block, different_layout_per_head)
+                 attention="bidirectional", horizontal_global_attention=False,
+                 seed=1234):
+        super().__init__(num_heads, block, different_layout_per_head, seed=seed)
         if attention not in ("unidirectional", "bidirectional"):
             raise NotImplementedError("attention must be uni/bidirectional")
         if horizontal_global_attention and attention != "bidirectional":
@@ -171,7 +184,7 @@ class VariableSparsityConfig(SparsityConfig):
         self.attention = attention
         self.horizontal_global_attention = horizontal_global_attention
 
-    def _random(self, h, layout):
+    def _random(self, h, layout, rng):
         nb = layout.shape[1]
         if self.num_random_blocks == 0:
             return layout
@@ -179,7 +192,7 @@ class VariableSparsityConfig(SparsityConfig):
             raise ValueError(f"num_random_blocks ({self.num_random_blocks}) exceeds "
                              f"row width ({nb})")
         for row in range(nb):
-            cols = random.sample(range(nb), self.num_random_blocks)
+            cols = rng.sample(range(nb), self.num_random_blocks)
             layout[h, row, cols] = 1
         return layout
 
@@ -218,8 +231,9 @@ class VariableSparsityConfig(SparsityConfig):
 
     def make_layout(self, seq_len):
         layout = self.setup_layout(seq_len)
+        rng = self.layout_rng()
         for h in range(self.num_layout_heads):
-            layout = self._random(h, layout)
+            layout = self._random(h, layout, rng)
             layout = self._local(h, layout)
             layout = self._global(h, layout)
         return self.propagate_first_head(layout)
@@ -230,8 +244,8 @@ class BigBirdSparsityConfig(SparsityConfig):
 
     def __init__(self, num_heads, block=16, different_layout_per_head=False,
                  num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1,
-                 attention="bidirectional"):
-        super().__init__(num_heads, block, different_layout_per_head)
+                 attention="bidirectional", seed=1234):
+        super().__init__(num_heads, block, different_layout_per_head, seed=seed)
         if attention not in ("unidirectional", "bidirectional"):
             raise NotImplementedError("attention must be uni/bidirectional")
         self.num_random_blocks = num_random_blocks
@@ -239,7 +253,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_global_blocks = num_global_blocks
         self.attention = attention
 
-    def _random(self, h, layout):
+    def _random(self, h, layout, rng):
         nb = layout.shape[1]
         if nb < self.num_random_blocks:
             raise ValueError(f"num_random_blocks ({self.num_random_blocks}) exceeds "
@@ -247,7 +261,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         for row in range(nb):
             pool = range(nb) if self.attention == "bidirectional" else range(row + 1)
             k = min(self.num_random_blocks, len(pool))
-            layout[h, row, random.sample(pool, k)] = 1
+            layout[h, row, rng.sample(pool, k)] = 1
         return layout
 
     def _sliding(self, h, layout):
@@ -265,8 +279,9 @@ class BigBirdSparsityConfig(SparsityConfig):
 
     def make_layout(self, seq_len):
         layout = self.setup_layout(seq_len)
+        rng = self.layout_rng()
         for h in range(self.num_layout_heads):
-            layout = self._random(h, layout)
+            layout = self._random(h, layout, rng)
             layout = self._sliding(h, layout)
             layout = self._global(h, layout)
             if self.attention == "unidirectional":

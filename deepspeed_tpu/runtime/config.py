@@ -21,6 +21,25 @@ TRAIN_BATCH_SIZE = "train_batch_size"
 TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
 GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
 
+# Reference-spelled keys read out of sections this schema deliberately models
+# as ``Dict[str, Any]`` (curriculum schedules, compression_training): dslint's
+# undeclared-config-key rule checks every string key read from a config dict
+# against the union of all ConfigModel fields AND this registry, so a typo'd
+# key is a lint error instead of a silent fall-through to the default.  Add a
+# key here ONLY when it matches the reference DeepSpeed spelling.
+DECLARED_EXTRA_KEYS = frozenset({
+    # curriculum learning schedule dict (reference runtime/data_pipeline/config.py
+    # + legacy get_curriculum_params spellings)
+    "curriculum_type", "schedule_type", "schedule_config", "min_difficulty",
+    "max_difficulty", "total_curriculum_step", "difficulty_step", "root_degree",
+    "difficulty", "max_step",
+    # compression_training sections (reference compression/config.py)
+    "weight_quantization", "sparse_pruning", "row_pruning", "head_pruning",
+    "channel_pruning", "different_groups", "shared_parameters",
+    "layer_reduction", "keep_layers", "keep_number_layer", "teacher_layer",
+    "module_name_prefix",
+})
+
 
 class FP16Config(ConfigModel):
     """Reference: deepspeed/runtime/fp16 config (runtime/config.py:125-180)."""
@@ -279,6 +298,10 @@ class SparseAttentionConfig(ConfigModel):
     global_block_end_indices: Optional[List[int]] = None
     # bigbird / bslongformer / local
     num_sliding_window_blocks: int = Field(3, ge=1)
+    # seeds the random-block placement (variable / bigbird) so layouts are
+    # reproducible AND rank-identical — every process derives the same layout
+    # from config alone instead of the global `random` module state
+    seed: int = Field(1234, ge=0)
 
     def model_validate(self):
         if self.block % 8 != 0:
@@ -305,13 +328,13 @@ class SparseAttentionConfig(ConfigModel):
                 num_heads, self.block, self.different_layout_per_head,
                 self.num_random_blocks or 0, self.local_window_blocks,
                 self.global_block_indices, self.global_block_end_indices,
-                attention, self.horizontal_global_attention)
+                attention, self.horizontal_global_attention, seed=self.seed)
         if self.mode == "bigbird":
             num_random = self.num_random_blocks if self.num_random_blocks is not None else 1
             return BigBirdSparsityConfig(
                 num_heads, self.block, self.different_layout_per_head,
                 num_random, self.num_sliding_window_blocks,
-                self.num_global_blocks, attention)
+                self.num_global_blocks, attention, seed=self.seed)
         if self.mode == "bslongformer":
             return BSLongformerSparsityConfig(
                 num_heads, self.block, self.different_layout_per_head,

@@ -29,7 +29,7 @@ _VERSION = 1
 # dtype codes shared with the reference format (indexed_dataset.py:101)
 DTYPES = {
     1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
-    6: np.float64, 7: np.double, 8: np.uint16, 9: np.uint32, 10: np.uint64,
+    6: np.float64, 7: np.double, 8: np.uint16, 9: np.uint32, 10: np.uint64,  # dslint: disable=float64-in-compute  # on-disk dtype-code table (reference .bin format); batches cast to the compute dtype at load
 }
 _CODES = {np.dtype(v): k for k, v in DTYPES.items()}
 

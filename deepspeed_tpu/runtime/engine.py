@@ -143,6 +143,10 @@ class Engine:
         self.lr_schedule = lr_schedules.build_lr_schedule(sched_cfg.type if sched_cfg else None,
                                                           dict(sched_cfg.params) if sched_cfg else {},
                                                           base_lr=self.base_lr)
+        # host-float reads of the schedule (offload/NVMe steps, engine.lr,
+        # telemetry) evaluate on the CPU backend — never an accelerator
+        # round-trip in the train hot loop
+        self._host_lr = lr_schedules.host_lr_fn(self.lr_schedule)
         self.lr_scheduler = lr_schedules.LRScheduler(self.lr_schedule)
 
         self.compute_dtype = config.precision_dtype
@@ -417,9 +421,9 @@ class Engine:
         rngs = jax.random.split(step_rng, gas)
         grads, loss, norm = self._offload_grad_fn(self._compute_params, batch, rngs)
         grad_leaves = jax.tree_util.tree_leaves(grads)
-        grads_np = {k: np.asarray(g, np.float32).ravel()
+        grads_np = {k: np.asarray(g, np.float32).ravel()  # dslint: disable=host-sync-in-hot-path  # ZeRO-Offload by design: grads must land on host for the CPU-Adam step
                     for k, g in zip(self._offload_keys, grad_leaves)}
-        lr = float(self.lr_schedule(jnp.int32(self.global_steps)))
+        lr = self._host_lr(self.global_steps)
         self._offload_state.step(grads_np, lr=lr)
         self._push_compute_params()
         return StepMetrics(loss=loss, grad_norm=norm, lr=jnp.float32(lr),
@@ -590,7 +594,7 @@ class Engine:
             return new_state, metrics
 
         shardings = self._state_shardings(jax.eval_shape(lambda s: s, self.state))
-        return jax.jit(train_step,
+        return jax.jit(train_step,  # dslint: disable=donation-after-use  # call-site contract: train_batch reassigns self.state from the result in the same statement; FlopsProfiler only lower()s (never executes) the callable
                        in_shardings=(shardings, None),
                        out_shardings=(shardings, None),
                        donate_argnums=(0, ))
@@ -675,7 +679,7 @@ class Engine:
             # device / in host buffers at a time; batch passes through whole
             self.telemetry.profile_step_boundary(self.global_steps)
             self.throughput.start()
-            lr = float(self.lr_schedule(self.global_steps))
+            lr = self._host_lr(self.global_steps)
             t0 = time.perf_counter()
             with self.telemetry.step_annotation(self.global_steps):
                 loss = self._nvme_trainer.train_step(batch, lr=lr)
@@ -692,9 +696,9 @@ class Engine:
                 self.telemetry.set_flops_per_step(None)
                 self._last_telemetry_record = self.telemetry.record_train_step(
                     step=self.global_steps, samples=self.global_samples,
-                    loss=float(loss), grad_norm=0.0, lr=lr, step_time_s=step_time,
+                    loss=loss, grad_norm=0.0, lr=lr, step_time_s=step_time,
                     tokens=self._batch_tokens(batch, seq_dim=1))
-            self._watchdog_check(metrics, loss_val=float(loss))
+            self._watchdog_check(metrics, loss_val=loss)
             self._maybe_report(metrics)
             return metrics
         if self._ltd_state is not None:
@@ -733,7 +737,7 @@ class Engine:
         t2 = 0.0
         if timed:
             # a value fetch is the only true sync; keep it off the fast path
-            loss_val = float(metrics.loss)
+            loss_val = float(metrics.loss)  # dslint: disable=host-sync-in-hot-path  # the step's ONE deliberate sync, opt-in via telemetry/wall_clock_breakdown (documented in TelemetryConfig)
             t2 = time.perf_counter()
         if breakdown:
             self._breakdown_acc = getattr(self, "_breakdown_acc", [0.0, 0.0, 0])
@@ -754,10 +758,13 @@ class Engine:
         if telemetry:
             if self.telemetry.wants_flops():
                 self.telemetry.set_flops_per_step(self._train_step_flops(batch))
+            # the step already synced for loss_val above: fetch the remaining
+            # scalars in ONE transfer instead of two more round-trips
+            grad_norm_val, lr_val = map(float, jax.device_get((metrics.grad_norm, metrics.lr)))  # dslint: disable=host-sync-in-hot-path  # telemetry opt-in: single batched fetch after the loss sync
             self._last_telemetry_record = self.telemetry.record_train_step(
                 step=self.global_steps, samples=self.global_samples,
-                loss=loss_val, grad_norm=float(metrics.grad_norm),
-                lr=float(metrics.lr), step_time_s=max(t2 - t1, 0.0) or None,
+                loss=loss_val, grad_norm=grad_norm_val,
+                lr=lr_val, step_time_s=max(t2 - t1, 0.0) or None,
                 tokens=self._batch_tokens(batch))
         if (self.config.telemetry.memory_breakdown
                 and self.global_steps % self.config.steps_per_print == 0):
@@ -868,7 +875,7 @@ class Engine:
         t0 = time.perf_counter()
         with self.telemetry.annotation("eval_batch"):
             loss = self._compiled_eval(params, batch, rng)
-            loss_val = float(loss)  # sync so the measured time covers execution
+            loss_val = float(loss)  # dslint: disable=host-sync-in-hot-path  # telemetry opt-in: sync so the measured time covers execution
         self.telemetry.record_events([
             ("Eval/loss", loss_val, self.global_samples),
             ("Eval/batch_time_ms", (time.perf_counter() - t0) * 1e3, self.global_samples)])
@@ -951,7 +958,7 @@ class Engine:
 
     @property
     def lr(self):
-        return float(self.lr_schedule(self.global_steps))
+        return self._host_lr(self.global_steps)
 
     def get_global_grad_norm(self):
         return None  # populated per-step in metrics

@@ -139,6 +139,41 @@ _SCHEDULE_BUILDERS = {
 }
 
 
+def host_lr_fn(schedule_fn: Callable) -> Callable:
+    """Host-side ``step -> float`` evaluation of a jnp schedule.
+
+    The schedules above are written with jnp so they trace into the jitted
+    train step (where the per-step lr belongs).  The offload and NVMe-streaming
+    paths instead need the lr as a HOST float every step; calling the schedule
+    eagerly puts that tiny computation on the default (accelerator) backend and
+    the ``float()`` read becomes a per-step device round-trip in the train hot
+    loop — dslint's host-sync-in-hot-path rule's first real catch.  Pinning the
+    evaluation to the CPU backend keeps the accelerator pipeline untouched; a
+    one-entry memo dedups the common read-twice-per-step pattern (train step +
+    telemetry/`engine.lr`).
+    """
+    import jax
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # no CPU backend registered: eager default-device eval
+        cpu = None
+    memo = {}
+
+    def host_schedule(step) -> float:
+        step = int(step)
+        if step not in memo:
+            if cpu is None:
+                value = float(schedule_fn(step))
+            else:
+                with jax.default_device(cpu):
+                    value = float(schedule_fn(step))
+            memo.clear()
+            memo[step] = value
+        return memo[step]
+
+    return host_schedule
+
+
 class LRScheduler:
     """Imperative wrapper with the torch-style surface the reference exposes
     (``step()``, ``get_lr()``, ``state_dict()``/``load_state_dict()``)."""

@@ -289,7 +289,7 @@ class SwappedLayerTrainer:
     # ---------------------------------------------------------- train step
     def train_step(self, batch: Dict[str, np.ndarray], lr: Optional[float] = None):
         """One full fwd+bwd+update with layer streaming.  Returns the loss."""
-        lr_f = float(lr) if lr is not None else self._default_lr
+        lr_f = float(lr) if lr is not None else self._default_lr  # dslint: disable=host-sync-in-hot-path  # lr arrives as a host scalar (engine._host_lr); this float() is a no-op coercion, not a device fetch
         if self.stem_fn is not None:
             x_tokens = jnp.asarray(batch["x"])
             x = self._stem_jit(self.stem, x_tokens)
@@ -308,7 +308,7 @@ class SwappedLayerTrainer:
                 self.swapper.swap_in_async(self._pkey(l + 1))
             # activation checkpoint: HBM by default (L x micro x seq x hidden
             # bf16 — ~0.5 GB at 7B/seq2048/micro1); host when requested
-            saved_inputs[l] = np.asarray(x) if self.offload_activations else x
+            saved_inputs[l] = np.asarray(x) if self.offload_activations else x  # dslint: disable=host-sync-in-hot-path  # opt-in cpu_checkpointing: offloading the activation to host RAM is the feature
             x = self._fwd_jit(self._device_params(host), x)
             self.swapper.release(self._pkey(l))
 
@@ -342,7 +342,7 @@ class SwappedLayerTrainer:
             else:
                 m_host = self.swapper.wait_in(self._mkey(l))
                 v_host = self.swapper.wait_in(self._vkey(l))
-            grads = [np.asarray(g, np.float32) for g in jax.tree_util.tree_leaves(dparams)]
+            grads = [np.asarray(g, np.float32) for g in jax.tree_util.tree_leaves(dparams)]  # dslint: disable=host-sync-in-hot-path  # ZeRO-Infinity by design: the host CPU-Adam steps each streamed layer, so its grads must land on host
             for p, m, v, g in zip(host, m_host, v_host, grads):
                 self.opt.step(p.ravel(), m.ravel(), v.ravel(), g.ravel(), lr=lr_f, step=step)
             # join THIS layer's writes (by rid — wait_all would orphan the
@@ -369,7 +369,7 @@ class SwappedLayerTrainer:
             self.stem, self._stem_m, self._stem_v = self._persist_opt(
                 self.stem, self._stem_m, self._stem_v, dstem,
                 jnp.float32(lr_f), jnp.int32(step))
-        return float(loss)
+        return float(loss)  # dslint: disable=host-sync-in-hot-path  # the step's one deliberate sync: the backward walk above already joined, and callers (engine nvme path) need the host loss
 
     def _head_grads(self, head32, x, batch):
         loss, grads = self._head_jit(head32, x, jnp.asarray(batch["y"]))

@@ -1,0 +1,22 @@
+"""dslint: JAX/TPU-aware static analysis purpose-built for this codebase.
+
+Entry points:
+
+- CLI: ``bin/dstpu-lint`` / ``python -m deepspeed_tpu.tools.staticcheck.cli``
+- ``make lint`` and the ``lint`` lane in ``run_tests.py`` (CI gate: non-zero
+  exit on any non-baselined finding)
+- library: ``run_lint(paths)`` / ``lint_source(src)`` for tests and tooling
+
+See rules.py for the rule catalog, suppressions.py for the inline
+``# dslint: disable=<rule>  # reason`` grammar, and baseline.py for the
+grandfathering policy.
+"""
+
+from .baseline import DEFAULT_BASELINE_NAME, load_baseline, save_baseline
+from .findings import Finding
+from .rules import META_RULES, RULES, build_rules
+from .runner import LintResult, lint_source, run_lint
+
+__all__ = ["DEFAULT_BASELINE_NAME", "Finding", "LintResult", "META_RULES",
+           "RULES", "build_rules", "lint_source", "load_baseline", "run_lint",
+           "save_baseline"]

@@ -1,0 +1,85 @@
+"""Committed baseline of grandfathered findings.
+
+The baseline is a JSON file mapping finding fingerprints (rule + path +
+source-line text — line-number-drift-proof, see findings.py) to an allowed
+count.  ``dstpu-lint`` subtracts baselined findings and exits non-zero only on
+NEW ones, so the tool can land on a codebase with known debt while ratcheting:
+fixing a flagged line retires its entry automatically (the fingerprint changes
+with the text), and ``--update-baseline`` rewrites the file from the current
+findings.  Policy: the baseline only ever shrinks — new code suppresses with a
+written reason instead of baselining.
+"""
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE_NAME = ".dslint-baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """fingerprint -> allowed count; missing file means an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: not a dslint baseline (expected "
+                         f"version={BASELINE_VERSION})")
+    counts: Dict[str, int] = {}
+    for entry in data.get("findings", []):
+        counts[entry["fingerprint"]] = counts.get(entry["fingerprint"], 0) + \
+            int(entry.get("count", 1))
+    return counts
+
+
+def load_baseline_entries(path: str) -> List[dict]:
+    """Raw baseline entries (for merging on partial updates); [] when absent."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        data = json.load(fh)
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: str, findings: List[Finding],
+                  preserve_entries: List[dict] = ()) -> None:
+    """Write the baseline from ``findings``; ``preserve_entries`` carries
+    forward raw entries from a previous baseline (files OUTSIDE the scope of a
+    partial run) so a subset update never deletes other files' entries."""
+    counts = Counter(f.fingerprint for f in findings)
+    by_fp = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        by_fp.setdefault(f.fingerprint, f)
+    entries = [{"fingerprint": fp,
+                "rule": by_fp[fp].rule,
+                "path": by_fp[fp].path,
+                "snippet": by_fp[fp].snippet,
+                "count": counts[fp]}
+               for fp in sorted(counts, key=lambda fp: by_fp[fp].sort_key())]
+    merged = sorted(list(preserve_entries) + entries,
+                    key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                                   e.get("fingerprint", "")))
+    with open(path, "w") as fh:
+        json.dump({"version": BASELINE_VERSION, "findings": merged}, fh, indent=1)
+        fh.write("\n")
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, int]) -> Tuple[List[Finding], List[Finding]]:
+    """Split into (new, baselined).  Counts matter: a fingerprint allowed twice
+    suppresses at most two occurrences."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        if remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

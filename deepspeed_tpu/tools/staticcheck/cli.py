@@ -1,0 +1,109 @@
+"""``dstpu-lint`` command line.
+
+    dstpu-lint [paths...]                # default: deepspeed_tpu/
+    dstpu-lint --format json             # machine-readable
+    dstpu-lint --update-baseline         # grandfather current findings
+    dstpu-lint --list-rules
+
+Exit codes: 0 clean, 1 non-baselined findings, 2 usage/internal error.
+"""
+
+import argparse
+import os
+import sys
+
+from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, load_baseline_entries,
+                       save_baseline)
+from .reporters import report_json, report_text
+from .rules import META_RULES, RULES, build_rules
+from .runner import run_lint
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu-lint",
+        description="JAX/TPU-aware static analysis for deepspeed_tpu (dslint)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: deepspeed_tpu/)")
+    p.add_argument("--root", default=None,
+                   help="repo root for relative paths + default baseline location "
+                        "(default: cwd)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON path (default: <root>/{DEFAULT_BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file (report everything)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current findings and exit 0")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule names to skip")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule names to run exclusively")
+    p.add_argument("--no-unused-suppressions", action="store_true",
+                   help="don't report stale suppression comments")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:26s} {RULES[name].description}")
+        for name, desc in sorted(META_RULES.items()):
+            print(f"{name:26s} (meta) {desc}")
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    paths = args.paths or [os.path.join(root, "deepspeed_tpu")]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"dstpu-lint: no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    try:
+        selected = [r.strip() for r in args.select.split(",") if r.strip()] or None
+        disabled = [r.strip() for r in args.disable.split(",") if r.strip()]
+        rules = build_rules(selected, disabled)
+    except KeyError as exc:
+        print(f"dstpu-lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+    if args.update_baseline and (selected or disabled):
+        # a restricted-rule run sees only a slice of the findings; rewriting
+        # the baseline from it would silently delete every other rule's entries
+        print("dstpu-lint: --update-baseline cannot be combined with "
+              "--select/--disable (it would drop the unselected rules' entries)",
+              file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
+    try:
+        baseline = {} if (args.no_baseline or args.update_baseline) \
+            else load_baseline(baseline_path)
+    except (ValueError, OSError) as exc:
+        print(f"dstpu-lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
+        return 2
+
+    result = run_lint(paths, root=root, rules=rules, baseline=baseline,
+                      report_unused_suppressions=not args.no_unused_suppressions)
+
+    if args.update_baseline:
+        # meta findings (stale suppressions, bad comments, parse errors) are
+        # actionable hygiene, never grandfathered; entries for files outside
+        # this run's scope are carried forward (a subset update must not
+        # delete other files' entries)
+        keep = [f for f in result.findings if f.rule not in META_RULES]
+        checked = set(result.checked_paths)
+        preserved = [e for e in load_baseline_entries(baseline_path)
+                     if e.get("path") not in checked]
+        save_baseline(baseline_path, keep, preserve_entries=preserved)
+        print(f"dstpu-lint: baseline updated ({len(keep)} finding(s) grandfathered, "
+              f"{len(preserved)} out-of-scope entr(ies) preserved) -> {baseline_path}")
+        return 0
+
+    print(report_json(result) if args.format == "json" else report_text(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

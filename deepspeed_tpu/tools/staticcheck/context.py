@@ -1,0 +1,318 @@
+"""Cross-module analysis context.
+
+One pass over every module under lint builds the project-wide facts the rules
+need:
+
+- which function defs are **jit roots** (passed to ``jax.jit`` by name,
+  decorated with it, or wrapped in ``functools.partial`` inside the jit call),
+  plus their static argument names (``static_argnums``/``static_argnames``);
+- where jitted callables **donate buffers** (``donate_argnums``) and how the
+  resulting callable is bound (local name, attribute, container, returned);
+- the set of **declared config keys**: every field name of every
+  ``ConfigModel`` subclass anywhere in the tree, every ``deprecated_names``
+  alias, every module-level ``<NAME> = "literal"`` key constant in
+  ``runtime/config.py``, and the ``DECLARED_EXTRA_KEYS`` registry (reference
+  spellings read out of deliberately-unmodeled ``Dict[str, Any]`` sections).
+"""
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+PARENT_FIELD = "_dslint_parent"
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            setattr(child, PARENT_FIELD, node)
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, PARENT_FIELD, None)
+
+
+def enclosing(node: ast.AST, *types) -> Optional[ast.AST]:
+    cur = parent(node)
+    while cur is not None and not isinstance(cur, types):
+        cur = parent(cur)
+    return cur
+
+
+def enclosing_statement(node: ast.AST) -> ast.stmt:
+    cur = node
+    while not isinstance(cur, ast.stmt):
+        nxt = parent(cur)
+        if nxt is None:
+            break
+        cur = nxt
+    return cur
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str  # absolute
+    relpath: str  # repo-relative, forward slashes
+    source: str
+    tree: ast.Module
+    lines: List[str]
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _is_jax_jit(func: ast.AST) -> bool:
+    """Matches ``jax.jit`` / bare ``jit`` (imported from jax)."""
+    if isinstance(func, ast.Attribute) and func.attr == "jit" and \
+            isinstance(func.value, ast.Name) and func.value.id == "jax":
+        return True
+    return isinstance(func, ast.Name) and func.id == "jit"
+
+
+def _is_partial(func: ast.AST) -> bool:
+    if isinstance(func, ast.Attribute) and func.attr == "partial":
+        return True
+    return isinstance(func, ast.Name) and func.id == "partial"
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    """Literal ints from ``donate_argnums=(0, 1)`` / ``static_argnums=2``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value, )
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value, )
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return tuple(el.value for el in node.elts
+                     if isinstance(el, ast.Constant) and isinstance(el.value, str))
+    return ()
+
+
+def param_names(fn: ast.AST) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in getattr(args, "posonlyargs", []) + args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+@dataclasses.dataclass
+class JitRoot:
+    fn: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    static_names: Set[str]
+    jit_call: Optional[ast.Call]  # None for decorator form
+
+
+@dataclasses.dataclass
+class DonationSite:
+    jit_call: ast.Call
+    donated: Tuple[int, ...]
+    # how the donating callable is bound at the jit site
+    binding: str  # "local" | "attribute" | "container" | "returned" | "immediate" | "other"
+    name: Optional[str]  # local/attribute name when binding is local/attribute
+    fn_node: Optional[ast.AST]  # resolved function def, when available
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Map every function name to its def node, per lexical scope chain."""
+
+    def __init__(self):
+        self.defs: List[Tuple[ast.AST, ast.AST]] = []  # (scope, fndef)
+
+    def visit_FunctionDef(self, node):
+        self.defs.append((enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef, ast.Module) or node, node))
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _resolve_function(name_node: ast.Name, tree: ast.Module,
+                      defs: List[Tuple[ast.AST, ast.AST]]) -> Optional[ast.AST]:
+    """Find the def for ``name_node`` by walking outward through lexical
+    scopes.  Good enough for the ``fn = def ...; jax.jit(fn)`` idiom; aliased
+    or imported callables resolve to None (and are skipped)."""
+    want = name_node.id
+    scope = enclosing(name_node, ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.ClassDef, ast.Module) or tree
+    while scope is not None:
+        for owner, fndef in defs:
+            if fndef.name == want and owner is scope:
+                return fndef
+        scope = enclosing(scope, ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Module)
+    return None
+
+
+def _jit_target(call: ast.Call, tree: ast.Module,
+                defs: List[Tuple[ast.AST, ast.AST]]) -> Optional[ast.AST]:
+    """The function def a ``jax.jit(...)`` call traces, unwrapping one level
+    of ``functools.partial``."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Call) and _is_partial(target.func) and target.args:
+        target = target.args[0]
+    if isinstance(target, ast.Lambda):
+        return target
+    if isinstance(target, ast.Name):
+        return _resolve_function(target, tree, defs)
+    return None
+
+
+def collect_jit_roots(module: ModuleInfo) -> Dict[int, JitRoot]:
+    """id(fn_node) -> JitRoot for every function this module jits."""
+    tree = module.tree
+    collector = _FunctionCollector()
+    collector.visit(tree)
+    roots: Dict[int, JitRoot] = {}
+
+    def add(fn, static_names, jit_call):
+        if fn is None:
+            return
+        prev = roots.get(id(fn))
+        if prev is not None:
+            prev.static_names |= static_names
+            return
+        roots[id(fn)] = JitRoot(fn=fn, static_names=set(static_names), jit_call=jit_call)
+
+    def static_names_of(call: ast.Call, fn) -> Set[str]:
+        static: Set[str] = set()
+        names = param_names(fn)
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static |= {names[i] for i in _int_tuple(kw.value) if i < len(names)}
+            elif kw.arg == "static_argnames":
+                static |= set(_str_tuple(kw.value))
+        return static
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            fn = _jit_target(node, tree, collector.defs)
+            add(fn, static_names_of(node, fn) if fn is not None else set(), node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    add(node, set(), None)
+                elif isinstance(dec, ast.Call) and (_is_jax_jit(dec.func) or (
+                        _is_partial(dec.func) and dec.args and _is_jax_jit(dec.args[0]))):
+                    # @jax.jit(...) / @partial(jax.jit, static_argnums=...) —
+                    # the static args live on the decorator call itself
+                    add(node, static_names_of(dec, node), None)
+    return roots
+
+
+def collect_donation_sites(module: ModuleInfo) -> List[DonationSite]:
+    tree = module.tree
+    collector = _FunctionCollector()
+    collector.visit(tree)
+    sites: List[DonationSite] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)):
+            continue
+        nums: Tuple[int, ...] = ()
+        names: Tuple[str, ...] = ()
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                nums = _int_tuple(kw.value)
+            elif kw.arg == "donate_argnames":
+                names = _str_tuple(kw.value)
+        if not nums and not names:
+            continue
+        fn_node = _jit_target(node, tree, collector.defs)
+        donated = set(nums)
+        if names and fn_node is not None:
+            # argnames resolve to positions through the traced fn's signature;
+            # an unresolvable target fn leaves only the argnums sites checkable
+            params = param_names(fn_node)
+            donated |= {params.index(n) for n in names if n in params}
+        donated = tuple(sorted(donated))
+        if not donated:
+            continue
+        up = parent(node)
+        binding, name = "other", None
+        if isinstance(up, ast.Call) and up.func is node:
+            binding = "immediate"
+        elif isinstance(up, ast.Return):
+            binding = "returned"
+        elif isinstance(up, ast.Assign) and len(up.targets) == 1:
+            tgt = up.targets[0]
+            if isinstance(tgt, ast.Name):
+                binding, name = "local", tgt.id
+            elif isinstance(tgt, ast.Attribute):
+                binding, name = "attribute", tgt.attr
+            elif isinstance(tgt, ast.Subscript):
+                binding = "container"
+        sites.append(DonationSite(jit_call=node, donated=donated, binding=binding,
+                                  name=name, fn_node=fn_node))
+    return sites
+
+
+# --------------------------------------------------------------- config keys
+CONFIG_BASE_NAMES = {"ConfigModel"}
+EXTRA_KEYS_REGISTRY = "DECLARED_EXTRA_KEYS"
+
+
+def _config_keys_from_module(tree: ast.Module) -> Set[str]:
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            base_names = {b.id for b in node.bases if isinstance(b, ast.Name)} | \
+                         {b.attr for b in node.bases if isinstance(b, ast.Attribute)}
+            if not (base_names & CONFIG_BASE_NAMES):
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                    keys.add(stmt.target.id)
+                    if isinstance(stmt.value, ast.Call):
+                        for kw in stmt.value.keywords:
+                            if kw.arg == "deprecated_names":
+                                keys |= set(_str_tuple(kw.value))
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tname = node.targets[0].id
+            if tname == EXTRA_KEYS_REGISTRY:
+                val = node.value
+                if isinstance(val, ast.Call) and val.args:  # frozenset({...})
+                    val = val.args[0]
+                keys |= set(_str_tuple(val))
+            elif tname.isupper() and isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                # module-level key constants (TRAIN_BATCH_SIZE = "train_batch_size")
+                keys.add(node.value.value)
+    return keys
+
+
+class ProjectContext:
+    """Facts shared by every rule over one lint invocation."""
+
+    def __init__(self, modules: List[ModuleInfo], extra_declared_keys=()):
+        self.modules = modules
+        self.declared_config_keys: Set[str] = set(extra_declared_keys)
+        self._jit_roots: Dict[str, Dict[int, JitRoot]] = {}
+        self._donations: Dict[str, List[DonationSite]] = {}
+        for mod in modules:
+            annotate_parents(mod.tree)
+            self.declared_config_keys |= _config_keys_from_module(mod.tree)
+            self._jit_roots[mod.relpath] = collect_jit_roots(mod)
+            self._donations[mod.relpath] = collect_donation_sites(mod)
+
+    def jit_roots(self, module: ModuleInfo) -> Dict[int, JitRoot]:
+        return self._jit_roots.get(module.relpath, {})
+
+    def donation_sites(self, module: ModuleInfo) -> List[DonationSite]:
+        return self._donations.get(module.relpath, [])

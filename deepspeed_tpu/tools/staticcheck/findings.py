@@ -1,0 +1,48 @@
+"""Finding record + fingerprinting.
+
+A finding's identity for baseline purposes is (rule, path, source-line TEXT) —
+not the line NUMBER — so unrelated edits above a grandfathered finding don't
+invalidate the baseline, while editing the flagged line itself (presumably to
+fix it) retires the entry.
+"""
+
+import dataclasses
+import hashlib
+from typing import Any, Dict
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str = ""  # the stripped source line the finding anchors to
+    severity: str = "error"
+    # last line of the enclosing statement (0 = same as `line`): a same-line
+    # suppression comment anywhere in a multi-line statement covers the finding
+    end_line: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        digest = hashlib.sha1(
+            f"{self.rule}::{self.path}::{self.snippet}".encode("utf-8", "replace")).hexdigest()
+        return digest[:16]
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+    def format_text(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.severity}[{self.rule}] {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet}"
+        return out

@@ -1,0 +1,28 @@
+"""Text and JSON reporters for lint results."""
+
+import json
+
+from .runner import LintResult
+
+
+def report_text(result: LintResult) -> str:
+    lines = [f.format_text() for f in result.findings]
+    s = result.summary()
+    tail = (f"dslint: {s['files_checked']} files, {len(result.rules_run)} rules, "
+            f"{s['findings']} finding(s) "
+            f"({s['baselined']} baselined, {s['suppressed']} suppressed) "
+            f"in {s['seconds']:.2f}s")
+    if result.findings:
+        by_rule = ", ".join(f"{k}={v}" for k, v in s["by_rule"].items())
+        tail += f"\n  by rule: {by_rule}"
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def report_json(result: LintResult) -> str:
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_dict() for f in result.findings],
+        "baselined": [f.to_dict() for f in result.baselined],
+        "summary": result.summary(),
+    }, indent=1)

@@ -1,0 +1,528 @@
+"""dslint rule registry.
+
+Every rule is grounded in a bug class this codebase actually hit (see the
+suppression reasons left in-tree for the survivors):
+
+- host-sync-in-hot-path: implicit device→host syncs inside train/eval/serving
+  step code (``float()``/``.item()``/``np.asarray()``/``jax.device_get``/
+  ``block_until_ready`` on device values) — each one stalls the XLA dispatch
+  pipeline for a full round-trip.
+- traced-control-flow: Python ``if``/``while`` on a jitted function's traced
+  parameters — a TracerBoolConversionError at best, silently-static control
+  flow at worst (when a call site happens to bind the value concretely).
+- donation-after-use: reading a buffer after passing it to a
+  ``jax.jit(..., donate_argnums=...)`` callable — XLA may have reused the
+  memory; also flags donating callables that escape module-local analysis
+  (returned / stored in containers), where every call site carries an
+  unverifiable contract.
+- nondeterministic-rng: global ``random``/``np.random`` module state in
+  library code (layouts/decisions diverge across ranks and reruns), and jax
+  PRNG keys fed to two consumers without an intervening ``split``.
+- silent-except: ``except Exception: pass`` — failures vanish instead of
+  being logged once.
+- float64-in-compute: explicit float64 dtypes that silently become float32
+  under default x64-disabled JAX (and double memory/bandwidth if x64 is on).
+- undeclared-config-key: string keys read from config dicts that no
+  ``ConfigModel`` schema declares — a typo'd key silently falls back to its
+  default instead of erroring.
+"""
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .context import (ModuleInfo, ProjectContext, enclosing, enclosing_statement,
+                      param_names, parent)
+from .findings import Finding
+
+RULES: Dict[str, type] = {}
+
+# meta findings emitted by the runner itself (documented for --list-rules)
+META_RULES = {
+    "bad-suppression": "malformed dslint control comment or suppression without a reason",
+    "unused-suppression": "suppression comment that matched no finding (stale — remove it)",
+    "parse-error": "file failed to parse; nothing else can be checked",
+}
+
+
+def register(cls):
+    RULES[cls.name] = cls
+    return cls
+
+
+class Rule:
+    name = "rule"
+    description = ""
+
+    def check(self, module: ModuleInfo, ctx: ProjectContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: ModuleInfo, node: ast.AST, message: str,
+                severity: str = "error") -> Finding:
+        stmt = enclosing_statement(node)
+        end = getattr(stmt, "end_lineno", None) or getattr(node, "end_lineno", 0) or 0
+        return Finding(rule=self.name, path=module.relpath, line=node.lineno,
+                       col=node.col_offset, message=message,
+                       snippet=module.snippet(node.lineno), severity=severity,
+                       end_line=end)
+
+
+def _walk_skipping(root: ast.AST, skip: Set[int]) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nodes whose id is in ``skip``."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in skip and node is not root:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+# --------------------------------------------------------------------------
+@register
+class HostSyncInHotPath(Rule):
+    name = "host-sync-in-hot-path"
+    description = ("device→host sync (float/.item/np.asarray/jax.device_get/"
+                   "block_until_ready) inside per-step train/eval/serving code")
+
+    HOT_NAMES = {"train_batch", "_offload_train_batch", "eval_batch",
+                 "decode_burst", "train_step"}
+    ENGINE_METHOD_NAMES = {"step"}  # hot only when defined on an *Engine class
+    NP_NAMES = {"np", "numpy", "onp"}
+
+    def _is_hot(self, fn: ast.AST) -> bool:
+        if fn.name in self.HOT_NAMES:
+            return True
+        if fn.name in self.ENGINE_METHOD_NAMES:
+            cls = enclosing(fn, ast.ClassDef)
+            return cls is not None and "Engine" in cls.name
+        return False
+
+    def check(self, module, ctx):
+        jit_roots = ctx.jit_roots(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._is_hot(node) or id(node) in jit_roots:
+                continue
+            # nested jitted defs run on device — their bodies can't host-sync
+            skip = {id(n) for n in ast.walk(node)
+                    if id(n) in jit_roots and n is not node}
+            for sub in _walk_skipping(node, skip):
+                if not isinstance(sub, ast.Call):
+                    continue
+                msg = self._sync_call(sub)
+                if msg:
+                    yield self.finding(module, sub, msg + f" inside hot path '{node.name}' "
+                                       "— every occurrence stalls dispatch for a host "
+                                       "round-trip; hoist it, batch it into one fetch, or "
+                                       "suppress with a reason if this is the step's one "
+                                       "deliberate sync")
+
+    def _sync_call(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == "float" and call.args and \
+                not isinstance(call.args[0], ast.Constant):
+            return "float() forces a device value to host"
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item":
+                return ".item() forces a device value to host"
+            if f.attr == "block_until_ready":
+                return ".block_until_ready() blocks on device execution"
+            if f.attr in ("asarray", "array") and isinstance(f.value, ast.Name) and \
+                    f.value.id in self.NP_NAMES:
+                return f"np.{f.attr}() copies a device value to host"
+            if f.attr == "device_get" and isinstance(f.value, ast.Name) and \
+                    f.value.id == "jax":
+                return "jax.device_get() copies device values to host"
+        return None
+
+
+# --------------------------------------------------------------------------
+@register
+class TracedControlFlow(Rule):
+    name = "traced-control-flow"
+    description = ("Python if/while on a traced parameter inside a jitted "
+                   "function (trace error, or silently-static branching)")
+
+    ALLOWED_CALLS = {"isinstance", "len", "getattr", "hasattr", "type", "callable"}
+
+    def check(self, module, ctx):
+        for root in ctx.jit_roots(module).values():
+            fn = root.fn
+            traced = set(param_names(fn)) - root.static_names
+            for child in ast.iter_child_nodes(fn):
+                yield from self._check_body(module, child, traced)
+
+    def _check_body(self, module, node, traced: Set[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # nested defs are traced too when called with traced values; their
+            # params join the traced set for their own subtree (conservative)
+            traced = traced | set(param_names(node))
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            bad = self._raw_traced_use(node.test, traced)
+            if bad:
+                sub = node
+                kind = "while" if isinstance(sub, ast.While) else "if"
+                yield self.finding(
+                    module, sub,
+                    f"Python `{kind}` on traced parameter '{bad}' of a jitted function — "
+                    f"use jnp.where/lax.cond/lax.while_loop, mark the argument static "
+                    f"(static_argnums / functools.partial before jit), or suppress with "
+                    f"a reason documenting why every call site binds it concretely")
+        for child in ast.iter_child_nodes(node):
+            yield from self._check_body(module, child, traced)
+
+    def _raw_traced_use(self, test: ast.expr, traced: Set[str]) -> Optional[str]:
+        for name in ast.walk(test):
+            if not (isinstance(name, ast.Name) and name.id in traced):
+                continue
+            if self._allowed(name, test):
+                continue
+            return name.id
+        return None
+
+    def _allowed(self, name: ast.Name, stop: ast.expr) -> bool:
+        cur = parent(name)
+        prev: ast.AST = name
+        while cur is not None:
+            if isinstance(cur, ast.Attribute) and cur.value is prev:
+                return True  # x.shape / x.ndim / x.dtype — static under trace
+            if isinstance(cur, ast.Call):
+                f = cur.func
+                if isinstance(f, ast.Name) and f.id in self.ALLOWED_CALLS:
+                    return True
+            if isinstance(cur, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in cur.ops):
+                return True  # `x is None` — identity, not value
+            if cur is stop:
+                return False
+            prev, cur = cur, parent(cur)
+        return False
+
+
+# --------------------------------------------------------------------------
+@register
+class DonationAfterUse(Rule):
+    name = "donation-after-use"
+    description = ("buffer read after being passed to a donate_argnums callable; "
+                   "also donating callables escaping module-local verification")
+
+    def check(self, module, ctx):
+        for site in ctx.donation_sites(module):
+            if site.binding == "immediate":
+                call = parent(site.jit_call)
+                yield from self._check_call(module, call, site.donated)
+            elif site.binding == "local":
+                fn = enclosing(site.jit_call, ast.FunctionDef, ast.AsyncFunctionDef)
+                scope = fn if fn is not None else module.tree
+                for call in self._calls_named(scope, site.name, attribute=False):
+                    yield from self._check_call(module, call, site.donated)
+            elif site.binding == "attribute":
+                for call in self._calls_named(module.tree, site.name, attribute=True):
+                    yield from self._check_call(module, call, site.donated)
+            else:
+                how = {"returned": "returned from its factory",
+                       "container": "stored into a container"}.get(
+                           site.binding, "bound in a way module-local analysis cannot follow")
+                yield self.finding(
+                    module, site.jit_call,
+                    f"donating callable (donate_argnums={site.donated}) is {how} — call "
+                    f"sites cannot be verified here; every caller must reassign the "
+                    f"donated argument(s) from the result. Suppress with a reason "
+                    f"naming the call sites that uphold the contract",
+                    severity="warning")
+
+    def _calls_named(self, scope: ast.AST, name: str, attribute: bool) -> Iterator[ast.Call]:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if attribute and isinstance(f, ast.Attribute) and f.attr == name:
+                yield node
+            elif not attribute and isinstance(f, ast.Name) and f.id == name:
+                yield node
+
+    def _check_call(self, module, call: ast.Call, donated: Tuple[int, ...]):
+        fn = enclosing(call, ast.FunctionDef, ast.AsyncFunctionDef)
+        if fn is None:
+            return
+        stmt = enclosing_statement(call)
+        end_line = getattr(stmt, "end_lineno", stmt.lineno)
+        for idx in donated:
+            if idx >= len(call.args):
+                continue
+            arg = call.args[idx]
+            if not isinstance(arg, (ast.Name, ast.Attribute)):
+                continue
+            expr = ast.unparse(arg)
+            if self._stored_in(stmt, expr):
+                continue  # reassigned from the result in the same statement
+            reuse = self._first_load_before_store(fn, expr, after_line=end_line)
+            if reuse is not None:
+                yield self.finding(
+                    module, reuse,
+                    f"'{expr}' is read after being DONATED to a jitted callable at "
+                    f"line {call.lineno} (donate_argnums includes position {idx}) — "
+                    f"XLA may have already reused its buffer; reassign it from the "
+                    f"call's result or drop the donation")
+
+    def _stored_in(self, stmt: ast.stmt, expr: str) -> bool:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Store) and \
+                    ast.unparse(node) == expr:
+                return True
+        return False
+
+    def _first_load_before_store(self, fn, expr: str, after_line: int) -> Optional[ast.AST]:
+        first_load = first_store = None
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if node.lineno <= after_line or ast.unparse(node) != expr:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                if first_store is None or node.lineno < first_store.lineno:
+                    first_store = node
+            elif isinstance(node.ctx, ast.Load):
+                if first_load is None or node.lineno < first_load.lineno:
+                    first_load = node
+        if first_load is None:
+            return None
+        if first_store is not None and first_store.lineno < first_load.lineno:
+            return None
+        return first_load
+
+
+# --------------------------------------------------------------------------
+@register
+class NondeterministicRNG(Rule):
+    name = "nondeterministic-rng"
+    description = ("global random/np.random module state in library code; "
+                   "jax PRNG key fed to two consumers without split")
+
+    GLOBAL_RANDOM_FNS = {"random", "randint", "sample", "choice", "choices",
+                         "shuffle", "uniform", "gauss", "seed", "randrange",
+                         "getrandbits", "betavariate", "expovariate"}
+    NP_RANDOM_FNS = {"seed", "rand", "randn", "randint", "random", "choice",
+                     "shuffle", "permutation", "standard_normal", "uniform",
+                     "normal", "sample", "random_sample"}
+    KEY_CONSUMERS = {"normal", "uniform", "bernoulli", "categorical", "randint",
+                     "truncated_normal", "permutation", "choice", "gumbel",
+                     "bits", "exponential", "laplace", "poisson", "gamma",
+                     "beta", "dirichlet", "rademacher", "ball", "orthogonal"}
+
+    def check(self, module, ctx):
+        random_aliases = self._module_aliases(module.tree, "random")
+        np_aliases = self._module_aliases(module.tree, "numpy") | \
+            {a for a in ("np", ) if a in self._imported_names(module.tree)}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                f = node.func
+                if isinstance(f.value, ast.Name) and f.value.id in random_aliases \
+                        and f.attr in self.GLOBAL_RANDOM_FNS:
+                    yield self.finding(
+                        module, node,
+                        f"global random.{f.attr}() in library code — layouts/decisions "
+                        f"differ across ranks and reruns; use a seeded random.Random "
+                        f"(or jax.random with a config-derived key)")
+                elif isinstance(f.value, ast.Attribute) and f.value.attr == "random" and \
+                        isinstance(f.value.value, ast.Name) and \
+                        f.value.value.id in (np_aliases or {"np"}) and \
+                        f.attr in self.NP_RANDOM_FNS:
+                    yield self.finding(
+                        module, node,
+                        f"global np.random.{f.attr}() in library code — use "
+                        f"np.random.default_rng(seed)")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_key_reuse(module, node)
+
+    def _module_aliases(self, tree, mod_name: str) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == mod_name:
+                        out.add(alias.asname or alias.name)
+        return out
+
+    def _imported_names(self, tree) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                out |= {a.asname or a.name for a in node.names}
+        return out
+
+    def _check_key_reuse(self, module, fn):
+        """Linear scan: the same Name passed as the key to two jax.random
+        consumers with no intervening reassignment."""
+        events: List[Tuple[int, int, str, str, ast.AST]] = []
+        nested = {id(n) for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn}
+        for node in _walk_skipping(fn, nested):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                events.append((node.lineno, node.col_offset, "store", node.id, node))
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                f = node.func
+                # jax.random.<dist> specifically — np.random.<fn> takes data,
+                # not a PRNG key, and is covered by the global-state check
+                if f.attr in self.KEY_CONSUMERS and isinstance(f.value, ast.Attribute) \
+                        and f.value.attr == "random" \
+                        and isinstance(f.value.value, ast.Name) \
+                        and f.value.value.id == "jax" and node.args \
+                        and isinstance(node.args[0], ast.Name):
+                    events.append((node.lineno, node.col_offset, "consume",
+                                   node.args[0].id, node))
+        # within one line, consumes order BEFORE stores: in `k = consume(k)` the
+        # RHS reads the old key, then the assignment rebinds — sorting by column
+        # would process the col-0 Store first, missing a real line-2 reuse and
+        # falsely flagging the legitimate post-rebind use
+        events.sort(key=lambda e: (e[0], 0 if e[2] == "consume" else 1, e[1]))
+        consumed: Dict[str, int] = {}
+        for line, _col, kind, name, node in events:
+            if kind == "store":
+                consumed.pop(name, None)
+            elif name in consumed:
+                yield self.finding(
+                    module, node,
+                    f"PRNG key '{name}' already consumed by a jax.random call at line "
+                    f"{consumed[name]} and reused here without jax.random.split — the "
+                    f"two draws are perfectly correlated")
+            else:
+                consumed[name] = line
+
+
+# --------------------------------------------------------------------------
+@register
+class SilentExcept(Rule):
+    name = "silent-except"
+    description = "broad `except: pass` — the failure vanishes without a log line"
+
+    BROAD = {"Exception", "BaseException"}
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if all(self._is_noop(stmt) for stmt in node.body):
+                what = ast.unparse(node.type) if node.type else "bare except"
+                yield self.finding(
+                    module, node,
+                    f"`except {what}` swallows the failure without logging — log once "
+                    f"(utils.logging.warning_once) or suppress with a reason why "
+                    f"silence is correct here")
+
+    def _is_broad(self, t: Optional[ast.expr]) -> bool:
+        if t is None:
+            return True
+        return _terminal_name(t) in self.BROAD
+
+    def _is_noop(self, stmt: ast.stmt) -> bool:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            return True
+        return isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+
+
+# --------------------------------------------------------------------------
+@register
+class Float64InCompute(Rule):
+    name = "float64-in-compute"
+    description = ("explicit float64 dtype — silently downcast to f32 under "
+                   "default x64-disabled JAX")
+
+    ATTR_OWNERS = {"np", "numpy", "jnp", "jax"}
+    F64_ATTRS = {"float64", "double"}
+    F64_STRINGS = {"float64", "f8", "<f8", ">f8"}
+    DTYPE_CALLS = {"astype", "asarray", "array", "zeros", "ones", "full", "empty",
+                   "arange", "linspace"}
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and node.attr in self.F64_ATTRS and \
+                    isinstance(node.value, ast.Name) and node.value.id in self.ATTR_OWNERS:
+                yield self.finding(
+                    module, node,
+                    f"{node.value.id}.{node.attr}: float64 never survives into device "
+                    f"compute (JAX default x64-disabled silently downcasts to f32) — "
+                    f"use float32, or suppress with a reason if this is host-only data")
+            elif isinstance(node, ast.Constant) and isinstance(node.value, str) and \
+                    node.value in self.F64_STRINGS and self._dtype_position(node):
+                yield self.finding(
+                    module, node,
+                    f'dtype "{node.value}": float64 never survives into device compute '
+                    f"(JAX default x64-disabled silently downcasts to f32) — use "
+                    f"float32, or suppress with a reason if this is host-only data")
+
+    def _dtype_position(self, node: ast.Constant) -> bool:
+        up = parent(node)
+        if isinstance(up, ast.keyword) and up.arg == "dtype":
+            return True
+        if isinstance(up, ast.Call) and node in up.args:
+            name = _terminal_name(up.func)
+            return name in self.DTYPE_CALLS
+        return False
+
+
+# --------------------------------------------------------------------------
+@register
+class UndeclaredConfigKey(Rule):
+    name = "undeclared-config-key"
+    description = ("string key read from a config dict that no ConfigModel "
+                   "schema declares — typos silently fall back to defaults")
+
+    EXACT_NAMES = {"config", "cfg", "ds_config", "user_config", "param_dict",
+                   "config_dict"}
+    SUFFIXES = ("_config", "_cfg")
+
+    def _is_config_ref(self, node: ast.AST) -> bool:
+        name = _terminal_name(node)
+        if name is None:
+            return False
+        return name in self.EXACT_NAMES or name.endswith(self.SUFFIXES)
+
+    def check(self, module, ctx):
+        declared = ctx.declared_config_keys
+        for node in ast.walk(module.tree):
+            key_node = None
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and self._is_config_ref(node.func.value) and \
+                    node.args and isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                key_node = node.args[0]
+            elif isinstance(node, ast.Subscript) and self._is_config_ref(node.value) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                # Load only: a WRITE establishes a key (it can't "fall back to
+                # a default"), so derived-key assignment stays legal
+                key_node = node.slice
+            if key_node is None:
+                continue
+            key = key_node.value
+            if key in declared or not key:
+                continue
+            yield self.finding(
+                module, node,
+                f"config key '{key}' is not declared by any ConfigModel schema or the "
+                f"DECLARED_EXTRA_KEYS registry (runtime/config.py) — a typo here "
+                f"silently falls back to the default; declare the key or fix the "
+                f"spelling")
+
+
+def build_rules(enabled: Optional[Iterable[str]] = None,
+                disabled: Iterable[str] = ()) -> List[Rule]:
+    names = list(RULES) if enabled is None else list(enabled)
+    unknown = [n for n in list(names) + list(disabled) if n not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}; known: {', '.join(RULES)}")
+    return [RULES[n]() for n in names if n not in set(disabled)]

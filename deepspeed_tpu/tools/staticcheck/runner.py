@@ -1,0 +1,169 @@
+"""Lint driver: walk files, build the project context, run rules, apply
+suppressions and the baseline."""
+
+import ast
+import dataclasses
+import os
+import time
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .baseline import apply_baseline
+from .context import ModuleInfo, ProjectContext
+from .findings import Finding
+from .rules import RULES, Rule, build_rules
+from .suppressions import SuppressionIndex, parse_suppressions
+
+EXCLUDE_DIR_NAMES = {"__pycache__", ".git", ".ipynb_checkpoints"}
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]            # active (non-baselined, non-suppressed)
+    baselined: List[Finding]
+    suppressed_count: int
+    files_checked: int
+    rules_run: List[str]
+    seconds: float
+    checked_paths: List[str] = dataclasses.field(default_factory=list)  # relpaths
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def summary(self) -> Dict[str, object]:
+        by_rule: Dict[str, int] = {}
+        for f in self.findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        return {"files_checked": self.files_checked,
+                "rules_run": self.rules_run,
+                "findings": len(self.findings),
+                "baselined": len(self.baselined),
+                "suppressed": self.suppressed_count,
+                "by_rule": dict(sorted(by_rule.items())),
+                "seconds": round(self.seconds, 2),
+                "ok": self.ok}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIR_NAMES)
+            out.extend(os.path.join(root, f) for f in sorted(files) if f.endswith(".py"))
+    return out
+
+
+def _relpath(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def load_modules(files: Sequence[str], root: str):
+    modules: List[ModuleInfo] = []
+    errors: List[Finding] = []
+    for path in files:
+        rel = _relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            line = getattr(exc, "lineno", 1) or 1
+            errors.append(Finding(rule="parse-error", path=rel, line=line, col=0,
+                                  message=f"cannot check file: {exc}"))
+            continue
+        modules.append(ModuleInfo(path=path, relpath=rel, source=source, tree=tree,
+                                  lines=source.splitlines()))
+    return modules, errors
+
+
+def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
+                 extra_declared_keys: Iterable[str] = (),
+                 report_unused_suppressions: bool = True,
+                 context_modules: Optional[List[ModuleInfo]] = None,
+                 _stats: Optional[Dict[str, int]] = None) -> List[Finding]:
+    """Findings come only from ``modules``; ``context_modules`` (a superset,
+    default = modules) feeds ProjectContext so a subset lint still sees the
+    whole package's schemas/registries."""
+    rules = rules if rules is not None else build_rules()
+    ctx = ProjectContext(context_modules or modules,
+                         extra_declared_keys=extra_declared_keys)
+    ran = {r.name for r in rules}
+    findings: List[Finding] = []
+    suppressed = 0
+    for mod in modules:
+        raw: List[Finding] = []
+        for rule in rules:
+            raw.extend(rule.check(mod, ctx))
+        suppressions, problems = parse_suppressions(mod.source, mod.relpath)
+        index = SuppressionIndex(suppressions)
+        kept = [f for f in raw if not index.suppresses(f)]
+        suppressed += len(raw) - len(kept)
+        kept.extend(problems)
+        if report_unused_suppressions:
+            for s in index.unused(ran):
+                kept.append(Finding(
+                    rule="unused-suppression", path=mod.relpath, line=s.line, col=s.col,
+                    message=f"suppression of {', '.join(s.rules)} matched no finding — "
+                            f"stale; remove it (reason was: {s.reason})",
+                    snippet=mod.snippet(s.line), severity="warning"))
+        findings.extend(kept)
+    if _stats is not None:
+        _stats["suppressed"] = suppressed
+    return sorted(findings, key=Finding.sort_key)
+
+
+def run_lint(paths: Sequence[str], root: Optional[str] = None,
+             rules: Optional[List[Rule]] = None,
+             baseline: Optional[Dict[str, int]] = None,
+             report_unused_suppressions: bool = True) -> LintResult:
+    t0 = time.perf_counter()
+    root = root or os.getcwd()
+    files = iter_python_files(paths)
+    modules, errors = load_modules(files, root)
+    rules = rules if rules is not None else build_rules()
+    # linting a SUBSET still needs whole-package context (ConfigModel schemas,
+    # the DECLARED_EXTRA_KEYS registry) or declared-key checks mass-misfire
+    context_modules = modules
+    pkg_root = os.path.join(root, "deepspeed_tpu")
+    if os.path.isdir(pkg_root):
+        have = {m.path for m in modules}
+        extra_files = [f for f in iter_python_files([pkg_root]) if f not in have]
+        if extra_files:
+            extra_modules, _ = load_modules(extra_files, root)
+            context_modules = modules + extra_modules
+    stats: Dict[str, int] = {}
+    all_findings = errors + lint_modules(
+        modules, rules, report_unused_suppressions=report_unused_suppressions,
+        context_modules=context_modules, _stats=stats)
+    active, baselined = apply_baseline(all_findings, baseline or {})
+    checked = sorted({m.relpath for m in modules} | {e.path for e in errors})
+    return LintResult(findings=active, baselined=baselined,
+                      suppressed_count=stats.get("suppressed", 0),
+                      files_checked=len(files),
+                      rules_run=[r.name for r in rules],
+                      seconds=time.perf_counter() - t0,
+                      checked_paths=checked)
+
+
+def lint_source(source: str, filename: str = "snippet.py",
+                rule_names: Optional[Sequence[str]] = None,
+                extra_declared_keys: Iterable[str] = (),
+                report_unused_suppressions: bool = False) -> List[Finding]:
+    """Test/fixture helper: lint one source string in isolation."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        return [Finding(rule="parse-error", path=filename, line=exc.lineno or 1,
+                        col=0, message=str(exc))]
+    mod = ModuleInfo(path=filename, relpath=filename, source=source, tree=tree,
+                     lines=source.splitlines())
+    rules = build_rules(rule_names) if rule_names is not None else build_rules()
+    return lint_modules([mod], rules, extra_declared_keys=extra_declared_keys,
+                        report_unused_suppressions=report_unused_suppressions)

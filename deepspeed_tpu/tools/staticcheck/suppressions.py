@@ -1,0 +1,114 @@
+"""Inline suppression comments.
+
+Grammar (the reason is REQUIRED — a suppression without one is inert and is
+itself reported, so every grandfathered pattern carries a written
+justification):
+
+    x = float(loss)  # dslint: disable=host-sync-in-hot-path  # one sync/step by design
+    # dslint: disable-next-line=silent-except  # interpreter-shutdown teardown
+    # dslint: disable-file=nondeterministic-rng  # fuzz harness, randomness is the point
+
+Comments are located with ``tokenize`` (never by regexing raw lines), so
+string literals that merely look like suppressions are ignored.
+"""
+
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+_PATTERN = re.compile(
+    r"dslint:\s*(?P<kind>disable(?:-next-line|-file)?)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"(?:\s*#\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    kind: str  # disable | disable-next-line | disable-file
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+    line: int  # line the COMMENT sits on
+    col: int
+    hits: int = 0
+
+    @property
+    def target_line(self) -> Optional[int]:
+        if self.kind == "disable":
+            return self.line
+        if self.kind == "disable-next-line":
+            return self.line + 1
+        return None  # file-level
+
+
+def parse_suppressions(source: str, path: str) -> Tuple[List[Suppression], List[Finding]]:
+    """Extract suppressions from ``source``.  Malformed ones (missing reason)
+    come back as ``bad-suppression`` findings and suppress nothing."""
+    suppressions: List[Suppression] = []
+    problems: List[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [t for t in tokens if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return [], []
+    lines = source.splitlines()
+    for tok in comments:
+        if "dslint:" not in tok.string:
+            continue
+        m = _PATTERN.search(tok.string)
+        line, col = tok.start
+        snippet = lines[line - 1].strip() if line <= len(lines) else ""
+        if not m:
+            problems.append(Finding(
+                rule="bad-suppression", path=path, line=line, col=col,
+                message="unparsable dslint control comment; expected "
+                        "'# dslint: disable[-next-line|-file]=<rule>[,<rule>]  # reason'",
+                snippet=snippet))
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        reason = m.group("reason")
+        if not reason:
+            problems.append(Finding(
+                rule="bad-suppression", path=path, line=line, col=col,
+                message=f"suppression of {', '.join(rules)} has no reason; append "
+                        f"'  # <why this finding is acceptable>' (the suppression is inert)",
+                snippet=snippet))
+            continue
+        suppressions.append(Suppression(kind=m.group("kind"), rules=rules,
+                                        reason=reason, line=line, col=col))
+    return suppressions, problems
+
+
+class SuppressionIndex:
+    """Answers 'is finding F suppressed?' and tracks which suppressions fired."""
+
+    def __init__(self, suppressions: Iterable[Suppression]):
+        self.file_level: List[Suppression] = []
+        self.by_line: Dict[int, List[Suppression]] = {}
+        self.all: List[Suppression] = list(suppressions)
+        for s in self.all:
+            target = s.target_line
+            if target is None:
+                self.file_level.append(s)
+            else:
+                self.by_line.setdefault(target, []).append(s)
+
+    def suppresses(self, finding: Finding) -> bool:
+        candidates = list(self.file_level)
+        for line in range(finding.line, max(finding.end_line, finding.line) + 1):
+            candidates.extend(self.by_line.get(line, []))
+        for s in candidates:
+            if finding.rule in s.rules:
+                s.hits += 1
+                return True
+        return False
+
+    def unused(self, ran_rules: Set[str]) -> List[Suppression]:
+        """Suppressions that matched nothing — but only for rules that actually
+        ran this invocation (a ``--disable``d rule doesn't orphan its
+        suppressions)."""
+        return [s for s in self.all
+                if s.hits == 0 and all(r in ran_rules for r in s.rules)]

@@ -9,7 +9,7 @@ computation before reading the clock.
 import time
 from typing import Dict, List, Optional
 
-from .logging import log_dist
+from .logging import log_dist, warning_once
 
 
 def _device_sync():
@@ -17,8 +17,9 @@ def _device_sync():
         import jax
         import jax.numpy as jnp
         jnp.zeros(()).block_until_ready()
-    except Exception:
-        pass
+    except Exception as exc:  # no backend: timers read the clock unsynchronized
+        warning_once(f"timer: device sync unavailable ({exc!r}); wall-clock "
+                     f"readings will not include in-flight device work")
 
 
 class _Timer:

@@ -1,0 +1,124 @@
+"""dstpu-lint CLI: exit codes, JSON format, baseline update, rule selection."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.tools.staticcheck.cli import main
+
+DIRTY = textwrap.dedent("""
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+    """)
+
+CLEAN = "def f():\n    return 1\n"
+
+
+@pytest.fixture
+def tree(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "dirty.py").write_text(DIRTY)
+    (pkg / "clean.py").write_text(CLEAN)
+    return tmp_path
+
+
+def run_cli(args, capsys):
+    rc = main(args)
+    out = capsys.readouterr().out
+    return rc, out
+
+
+def test_exit_one_on_findings_and_zero_when_clean(tree, capsys):
+    rc, out = run_cli([str(tree / "pkg" / "dirty.py"), "--root", str(tree)], capsys)
+    assert rc == 1 and "silent-except" in out
+    rc, out = run_cli([str(tree / "pkg" / "clean.py"), "--root", str(tree)], capsys)
+    assert rc == 0
+
+
+def test_json_format_is_machine_readable(tree, capsys):
+    rc, out = run_cli([str(tree / "pkg"), "--root", str(tree), "--format", "json"], capsys)
+    assert rc == 1
+    data = json.loads(out)
+    assert data["summary"]["findings"] == 1
+    (finding, ) = data["findings"]
+    assert finding["rule"] == "silent-except"
+    assert finding["path"] == "pkg/dirty.py"
+    assert finding["fingerprint"]
+
+
+def test_update_baseline_then_clean_then_new_finding(tree, capsys):
+    pkg = str(tree / "pkg")
+    rc, out = run_cli([pkg, "--root", str(tree), "--update-baseline"], capsys)
+    assert rc == 0
+    assert os.path.exists(str(tree / ".dslint-baseline.json"))
+    rc, _ = run_cli([pkg, "--root", str(tree)], capsys)
+    assert rc == 0  # grandfathered
+    (tree / "pkg" / "more.py").write_text(DIRTY.replace("def f", "def q"))
+    rc, out = run_cli([pkg, "--root", str(tree)], capsys)
+    assert rc == 1 and "more.py" in out  # new finding not masked
+
+
+def test_no_baseline_flag_reports_everything(tree, capsys):
+    pkg = str(tree / "pkg")
+    run_cli([pkg, "--root", str(tree), "--update-baseline"], capsys)
+    rc, out = run_cli([pkg, "--root", str(tree), "--no-baseline"], capsys)
+    assert rc == 1
+
+
+def test_select_and_disable(tree, capsys):
+    pkg = str(tree / "pkg")
+    rc, _ = run_cli([pkg, "--root", str(tree), "--disable", "silent-except"], capsys)
+    assert rc == 0
+    rc, _ = run_cli([pkg, "--root", str(tree), "--select", "silent-except"], capsys)
+    assert rc == 1
+    assert main([pkg, "--root", str(tree), "--select", "no-such-rule"]) == 2
+
+
+def test_update_baseline_refuses_rule_restriction(tree, capsys):
+    rc = main([str(tree / "pkg"), "--root", str(tree), "--update-baseline",
+               "--select", "silent-except"])
+    assert rc == 2
+    rc = main([str(tree / "pkg"), "--root", str(tree), "--update-baseline",
+               "--disable", "silent-except"])
+    assert rc == 2
+
+
+def test_update_baseline_on_subset_preserves_other_files(tree, capsys):
+    pkg = str(tree / "pkg")
+    (tree / "pkg" / "other.py").write_text(DIRTY.replace("def f", "def other_f"))
+    run_cli([pkg, "--root", str(tree), "--update-baseline"], capsys)
+    # re-baselining ONLY dirty.py must not delete other.py's entry
+    rc, out = run_cli([str(tree / "pkg" / "dirty.py"), "--root", str(tree),
+                       "--update-baseline"], capsys)
+    assert rc == 0 and "preserved" in out
+    rc, _ = run_cli([pkg, "--root", str(tree)], capsys)
+    assert rc == 0  # both files still grandfathered
+
+
+def test_subset_lint_sees_whole_package_schema(capsys):
+    """Linting ONE file of the real package must still know the ConfigModel
+    fields + DECLARED_EXTRA_KEYS declared elsewhere (runtime/config.py)."""
+    import deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler as cs
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    rc, out = run_cli([cs.__file__, "--root", root], capsys)
+    assert rc == 0, out
+
+
+def test_missing_path_is_usage_error(tree):
+    assert main([str(tree / "nope"), "--root", str(tree)]) == 2
+
+
+def test_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync-in-hot-path", "traced-control-flow", "donation-after-use",
+                 "nondeterministic-rng", "silent-except", "float64-in-compute",
+                 "undeclared-config-key", "bad-suppression", "unused-suppression"):
+        assert rule in out

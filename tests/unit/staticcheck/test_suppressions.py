@@ -1,0 +1,125 @@
+"""Suppression-comment grammar: reasons are mandatory, next-line/file scopes
+work, stale suppressions are themselves findings."""
+
+import textwrap
+
+from deepspeed_tpu.tools.staticcheck import lint_source
+
+SNIPPET_WITH_FINDING = """
+    def f():
+        try:
+            g()
+        except Exception:{comment}
+            pass
+"""
+
+
+def run(src, **kw):
+    return lint_source(textwrap.dedent(src), **kw)
+
+
+def test_same_line_suppression_with_reason():
+    out = run(SNIPPET_WITH_FINDING.format(
+        comment="  # dslint: disable=silent-except  # teardown path, logging is gone"))
+    assert out == []
+
+
+def test_suppression_without_reason_is_inert_and_reported():
+    out = run(SNIPPET_WITH_FINDING.format(comment="  # dslint: disable=silent-except"))
+    rules = sorted(f.rule for f in out)
+    assert rules == ["bad-suppression", "silent-except"]
+
+
+def test_next_line_suppression():
+    out = run("""
+        def f():
+            try:
+                g()
+            # dslint: disable-next-line=silent-except  # teardown path
+            except Exception:
+                pass
+        """)
+    assert out == []
+
+
+def test_file_level_suppression():
+    out = run("""
+        # dslint: disable-file=silent-except  # generated shim, exceptions intentionally dropped
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+
+        def h():
+            try:
+                g()
+            except Exception:
+                pass
+        """)
+    assert out == []
+
+
+def test_wrong_rule_name_does_not_suppress():
+    out = run(SNIPPET_WITH_FINDING.format(
+        comment="  # dslint: disable=host-sync-in-hot-path  # wrong rule"),
+        report_unused_suppressions=True)
+    rules = sorted(f.rule for f in out)
+    # the real finding survives AND the no-op suppression is reported stale
+    assert rules == ["silent-except", "unused-suppression"]
+
+
+def test_unused_suppression_reported_with_reason_text():
+    out = run("""
+        def fine():  # dslint: disable=silent-except  # nothing here anymore
+            return 1
+        """, report_unused_suppressions=True)
+    assert [f.rule for f in out] == ["unused-suppression"]
+    assert "nothing here anymore" in out[0].message
+
+
+def test_unused_not_reported_when_rule_disabled():
+    out = run("""
+        def f():
+            try:
+                g()
+            except Exception:  # dslint: disable=silent-except  # teardown
+                pass
+        """, rule_names=["host-sync-in-hot-path"], report_unused_suppressions=True)
+    assert out == []  # silent-except didn't run, so its suppression isn't stale
+
+
+def test_one_comment_covers_multiple_findings_on_the_line():
+    out = run("""
+        import numpy as np
+        D = {6: np.float64, 7: np.double}  # dslint: disable=float64-in-compute  # on-disk dtype table
+        """)
+    assert out == []
+
+
+def test_comment_on_continuation_line_of_multiline_statement():
+    # the natural end-of-statement comment placement must cover a finding
+    # anchored to the statement's FIRST line (and not read as stale)
+    out = run("""
+        class Engine:
+            def train_batch(self, x):
+                y = np.asarray(
+                    x)  # dslint: disable=host-sync-in-hot-path  # deliberate fetch
+                return y
+        """, report_unused_suppressions=True)
+    assert out == []
+
+
+def test_suppression_inside_string_literal_is_ignored():
+    out = run('''
+        DOC = """
+        # dslint: disable-file=silent-except  # not a real comment
+        """
+
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        ''')
+    assert [f.rule for f in out] == ["silent-except"]
