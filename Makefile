@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke lint lint-baseline
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -39,3 +39,9 @@ telemetry-smoke:
 # last valid checkpoint → 3-step loss continuity (fault-injection harness)
 resilience-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --resilience-smoke
+
+# fault-injected mixed-arrival serving run on CPU (probabilistic KV-allocator
+# failures + throttled admission waves): every request must finish ok with
+# zero stalls and the KV pool fully reclaimed; also a lane in run_tests.py
+serving-resilience-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --serving-resilience-smoke

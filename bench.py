@@ -684,7 +684,7 @@ def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
     arrive (``arrivals``: {step_idx: [uids]}) WHILE earlier ones decode, so
     SplitFuse actually mixes prefill chunks and decode singles in one ragged
     batch.  Returns (total_new_tokens, elapsed_s, per-step latencies of
-    token-emitting steps)."""
+    token-emitting steps, hit_stall_bail)."""
     produced = {u: 0 for u in range(len(prompts))}
     done = set()
     pending = dict(arrivals)
@@ -721,7 +721,7 @@ def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
                 done.add(uid)
                 eng.flush(uid)
         step_i += 1
-    return tokens, time.perf_counter() - t_start, lats
+    return tokens, time.perf_counter() - t_start, lats, stalled > 100
 
 
 def measure_serving_mixed(on_tpu: bool):
@@ -760,14 +760,18 @@ def measure_serving_mixed(on_tpu: bool):
                 n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
                 n_req // 4 + 12: list(range(3 * n_req // 4, n_req))}
     _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
-    tokens, dt, lats = _run_serving_scenario(eng, prompts, arrivals, max_new)
+    tokens, dt, lats, hit_stall = _run_serving_scenario(eng, prompts, arrivals, max_new)
     if not lats:
         return {"serving_mixed": "no tokens emitted"}
     return {"serving_mixed_tok_s": round(tokens / dt, 1),
             "serving_mixed_p50_step_ms": round(float(np.percentile(lats, 50)) * 1e3, 1),
             "serving_mixed_p95_step_ms": round(float(np.percentile(lats, 95)) * 1e3, 1),
             "serving_mixed_requests": n_req,
-            "serving_mixed_arrival_waves": 3}
+            "serving_mixed_arrival_waves": 3,
+            # resilience counters (ISSUE 4): a clean run preempts rarely and
+            # never trips the scenario's own stall bail
+            "serving_mixed_preempted": int(eng.health()["preempted_total"]),
+            "serving_mixed_stalled": bool(hit_stall)}
 
 
 def measure_fsdp_virtual(timeout_s: int = 280):

@@ -6,6 +6,7 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
+from ..runtime.config import ServingResilienceConfig
 from ..runtime.config_utils import ConfigModel, Field
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
@@ -38,6 +39,10 @@ class InferenceConfig(ConfigModel):
     top_k: int = Field(0, ge=0)
     top_p: float = Field(1.0, gt=0.0, le=1.0)
     seed: int = 0
+    # admission control / load shedding / preemption / stall watchdog for the
+    # v2 ragged engine (runtime/config.py defines the section so train+serve
+    # configs share one spelling)
+    serving_resilience: ServingResilienceConfig = Field(ServingResilienceConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

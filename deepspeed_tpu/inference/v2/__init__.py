@@ -1,6 +1,9 @@
 """FastGen-style ragged/continuous-batching serving (reference deepspeed/inference/v2/)."""
-from .blocked_allocator import BlockedAllocator
+from .admission import (AdmissionQueue, RequestResult, ServingStalledError, ShedReason,
+                        REQUEST_STATUSES)
+from .blocked_allocator import BlockedAllocator, KVAllocationError
 from .engine_factory import build_engine, build_hf_engine
 from .engine_v2 import InferenceEngineV2
-from .ragged_manager import RaggedStateManager, SequenceDescriptor
+from .ragged_manager import (EmptyPromptError, RaggedStateManager, SequenceDescriptor,
+                             UnknownSequenceError)
 from .scheduler import ScheduledChunk, SplitFuseScheduler

@@ -1,0 +1,191 @@
+"""Serving admission control — bounded queue, deadlines, load shedding.
+
+The overload front door of the v2 ragged engine (the serving-side analog of
+the reference's request rejection in DeepSpeed-FastGen / MII: a request the
+pool cannot or should not take is turned away with a structured reason BEFORE
+any KV allocation, instead of detonating the whole batch mid-step).
+
+Three layers live here:
+
+- :class:`AdmissionQueue` — a bounded, priority-aware queue between ``put``
+  and the scheduler.  ``submit`` applies the load-shedding policy
+  (:class:`ShedReason` with a retryable/fatal verdict) and stamps each ticket
+  with its deadline; the engine pumps tickets into the
+  ``RaggedStateManager`` only while the KV pool has headroom.
+- :class:`RequestResult` — the per-request outcome ``generate(strict=False)``
+  returns: every request ends in exactly one terminal status instead of the
+  first failure raising away everyone else's tokens.
+- :class:`ServingStalledError` — raised by the engine's progress watchdog in
+  place of an unbounded ``while`` loop; carries a full state snapshot (live
+  uids, block-table occupancy, allocator free count) for postmortems.
+
+Thresholds come from ``ServingResilienceConfig`` (runtime/config.py
+``serving_resilience`` section).  All host-side; nothing here touches jax.
+"""
+
+import dataclasses
+import heapq
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# ----------------------------------------------------------- request statuses
+OK = "ok"
+SHED = "shed"
+DEADLINE_EXPIRED = "deadline_expired"
+PREEMPT_REQUEUED_EXHAUSTED = "preempt_requeued_exhausted"
+FAILED = "failed"
+
+REQUEST_STATUSES = (OK, SHED, DEADLINE_EXPIRED, PREEMPT_REQUEUED_EXHAUSTED, FAILED)
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal outcome of one served request.
+
+    ``tokens`` is prompt + generated for any request that reached the model
+    (possibly partial for evicted ones), empty for requests shed at admission.
+    ``retryable`` tells the client whether resubmitting later can succeed
+    (queue full / pool pressure / stall) or never will (over-cap prompt).
+    """
+    uid: int
+    status: str
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None  # eos | max_new_tokens | length_capped
+    reason: Optional[str] = None         # failure/shed/eviction detail
+    retryable: bool = False
+    queue_wait_s: float = 0.0
+    preemptions: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == OK
+
+
+@dataclasses.dataclass(frozen=True)
+class ShedReason:
+    """Structured admission rejection, decided before any KV allocation."""
+    code: str      # empty_prompt | prompt_over_cap | queue_full | kv_pressure
+    detail: str
+    retryable: bool
+
+    def __str__(self):
+        kind = "retryable" if self.retryable else "fatal"
+        return f"[{self.code}/{kind}] {self.detail}"
+
+
+class ServingStalledError(RuntimeError):
+    """The serving loop was live but unschedulable for the watchdog window.
+
+    Replaces the former spin-forever failure mode of ``generate()``
+    (engine_v2: ``while len(done) < len(uids)``) with a diagnosis:
+    ``snapshot`` holds live uids, per-sequence progress and block-table
+    occupancy, the allocator free count, and queue depth at trip time.
+    """
+
+    def __init__(self, message: str, snapshot: Dict[str, Any]):
+        super().__init__(message)
+        self.snapshot = snapshot
+
+
+@dataclasses.dataclass
+class AdmissionTicket:
+    uid: int
+    prompt: List[int]
+    priority: int = 0                  # lower pops first; ties are FIFO
+    deadline: Optional[float] = None   # absolute clock() time; None = no TTL
+    enqueue_t: float = 0.0
+
+
+class AdmissionQueue:
+    """Bounded, priority-aware admission queue with structured load shedding.
+
+    ``submit`` either enqueues a ticket (stamped with its TTL deadline) or
+    returns the :class:`ShedReason` that turned it away — the caller decides
+    whether that raises (strict) or becomes a ``shed`` RequestResult.  The
+    shedding policy runs against queue depth and the CALLER-OBSERVED KV
+    utilization, so rejection happens before the request ever owns a block.
+
+    ``clock`` is injectable (fault tests drive a fake clock); defaults to
+    ``time.monotonic``.
+    """
+
+    def __init__(self, config=None, *, clock=time.monotonic):
+        from ...runtime.config import ServingResilienceConfig
+        self.config = config if config is not None else ServingResilienceConfig()
+        self.clock = clock
+        self._heap: List[Tuple[int, int, AdmissionTicket]] = []
+        self._seq = 0  # FIFO tiebreak within a priority class
+        self.submitted_total = 0
+        self.shed_total = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    # ------------------------------------------------------------- shedding
+    def shed_reason(self, prompt_len: int, *, kv_utilization: Optional[float] = None,
+                    token_cap: Optional[int] = None) -> Optional[ShedReason]:
+        """The policy verdict for a prospective request; None = admit."""
+        if prompt_len <= 0:
+            return ShedReason("empty_prompt", "prompt has no tokens — a zero-pending "
+                              "sequence can never be scheduled or retired", retryable=False)
+        if token_cap is not None and prompt_len > token_cap:
+            return ShedReason("prompt_over_cap",
+                              f"prompt of {prompt_len} tokens exceeds the per-sequence "
+                              f"KV cap of {token_cap} tokens", retryable=False)
+        depth_cap = self.config.max_queue_depth
+        if depth_cap and len(self._heap) >= depth_cap:
+            return ShedReason("queue_full",
+                              f"admission queue at max_queue_depth={depth_cap}",
+                              retryable=True)
+        shed_at = self.config.shed_kv_utilization
+        if kv_utilization is not None and shed_at < 1.0 and kv_utilization >= shed_at:
+            return ShedReason("kv_pressure",
+                              f"KV utilization {kv_utilization:.3f} >= shed threshold "
+                              f"{shed_at} (pool pressure)", retryable=True)
+        return None
+
+    # --------------------------------------------------------------- intake
+    def submit(self, uid: int, prompt: List[int], *, priority: int = 0,
+               ttl_s: Optional[float] = None, kv_utilization: Optional[float] = None,
+               token_cap: Optional[int] = None) -> Optional[ShedReason]:
+        """Admit-or-shed.  Returns None on admission, else the ShedReason."""
+        self.submitted_total += 1
+        reason = self.shed_reason(len(prompt), kv_utilization=kv_utilization,
+                                  token_cap=token_cap)
+        if reason is not None:
+            self.shed_total += 1
+            return reason
+        now = self.clock()
+        ttl = ttl_s if ttl_s is not None else self.config.default_ttl_s
+        # `is not None`, not truthiness: an explicit ttl of 0.0 (a spent
+        # budget) means "already expired", not "no deadline"
+        ticket = AdmissionTicket(uid=int(uid), prompt=list(prompt), priority=int(priority),
+                                 deadline=(now + ttl) if ttl is not None else None,
+                                 enqueue_t=now)
+        heapq.heappush(self._heap, (ticket.priority, self._seq, ticket))
+        self._seq += 1
+        return None
+
+    # ---------------------------------------------------------------- drain
+    def pop_ready(self) -> Tuple[Optional[AdmissionTicket], List[AdmissionTicket]]:
+        """Pop the next ticket whose deadline has not passed.
+
+        Returns ``(ticket_or_none, expired)`` — tickets that died waiting in
+        the queue come back in ``expired`` so the engine can finalize them as
+        ``deadline_expired`` (they never owned KV blocks).
+        """
+        expired: List[AdmissionTicket] = []
+        now = self.clock()
+        while self._heap:
+            _, _, ticket = heapq.heappop(self._heap)
+            if ticket.deadline is not None and now >= ticket.deadline:
+                expired.append(ticket)
+                continue
+            return ticket, expired
+        return None, expired
+
+    def drain(self) -> List[AdmissionTicket]:
+        """Remove and return every queued ticket (stall cleanup), in pop order."""
+        out = [entry[2] for entry in sorted(self._heap, key=lambda e: (e[0], e[1]))]
+        self._heap = []
+        return out

@@ -3,9 +3,20 @@
 Analog of the reference BlockedAllocator (inference/v2/ragged/blocked_allocator.py):
 fixed number of KV blocks, O(1) allocate/free via a free list.  The last block
 id is reserved as the trash target for padded writes (models.llama.forward_paged).
+
+Failures raise :class:`KVAllocationError` (a RuntimeError) so callers can tell
+"the pool is tight, retry later" apart from programming errors — the SplitFuse
+scheduler treats it as a failed reservation and retries the chunk on a later
+step, which is also the seam the serving fault-injection harness drives
+(tests/unit/fault_injection_serving.py FaultyBlockedAllocator).
 """
 
 from typing import List
+
+
+class KVAllocationError(RuntimeError):
+    """The KV pool could not satisfy an allocation (exhausted, or an injected
+    transient fault).  Retryable: freed blocks make the same request succeed."""
 
 
 class BlockedAllocator:
@@ -16,6 +27,9 @@ class BlockedAllocator:
         self.num_blocks = num_blocks
         self.trash_block = num_blocks - 1
         self._free: List[int] = list(range(num_blocks - 1))  # trash never allocated
+        # every outstanding block id; a free() of a block not in here is a
+        # double free (the bug class that silently aliases two sequences' KV)
+        self._in_use: set = set()
 
     @property
     def free_blocks(self) -> int:
@@ -23,13 +37,21 @@ class BlockedAllocator:
 
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
-            raise RuntimeError(f"KV pool exhausted: requested {n}, free {len(self._free)}")
+            raise KVAllocationError(f"KV pool exhausted: requested {n}, free {len(self._free)}")
         out = self._free[:n]
         self._free = self._free[n:]
+        self._in_use.update(out)
         return out
 
     def free(self, blocks: List[int]) -> None:
+        seen = set()
         for b in blocks:
             if b == self.trash_block or b < 0 or b >= self.num_blocks:
                 raise ValueError(f"bad block id {b}")
+            if b not in self._in_use or b in seen:
+                raise ValueError(f"double free of block {b}: not currently allocated "
+                                 f"(would alias two sequences onto one KV block)")
+            seen.add(b)
+        for b in blocks:
+            self._in_use.discard(b)
         self._free.extend(blocks)

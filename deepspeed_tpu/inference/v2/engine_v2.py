@@ -10,9 +10,9 @@ one per ragged shape — the XLA analog of the reference's CUDA-graph-free
 ragged kernels.
 """
 
-import functools
 import inspect
-from typing import Any, Callable, Dict, List, Optional, Sequence
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -22,8 +22,11 @@ from jax.sharding import PartitionSpec
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...utils.logging import log_dist
 from ..config import DTYPES as _DTYPES, load_inference_config
+from .admission import (DEADLINE_EXPIRED, FAILED, OK, PREEMPT_REQUEUED_EXHAUSTED, SHED,
+                        AdmissionQueue, RequestResult, ServingStalledError)
+from .blocked_allocator import KVAllocationError
 from .ragged_manager import RaggedStateManager
-from .scheduler import ScheduledChunk, SplitFuseScheduler
+from .scheduler import SplitFuseScheduler
 
 def candidate_sample(row, rng, *, temperature, top_k, top_p, axis):
     """Candidate-set sampling over a vocab-sharded logits row (reference
@@ -51,12 +54,18 @@ def candidate_sample(row, rng, *, temperature, top_k, top_p, axis):
 
 class InferenceEngineV2:
 
+    # decode-burst length while any live request carries a deadline: the
+    # deadline is only enforceable between host round-trips, so this bounds
+    # eviction overshoot (tokens decoded past expiry) while keeping ~SLICE x
+    # fewer round-trips than stepwise decode
+    BURST_DEADLINE_SLICE = 8
+
     def __init__(self, model_module, model_config, params, config: Optional[Dict] = None,
                  num_blocks: int = 512, block_size: int = 16,
                  max_blocks_per_seq: int = 64, token_budget: int = 256,
                  max_seqs_per_step: int = 32,
                  topology: Optional[MeshTopology] = None,
-                 telemetry=None):
+                 telemetry=None, clock: Optional[Callable[[], float]] = None):
         self.config = load_inference_config(config)
         self.model = model_module
         self.model_config = model_config
@@ -66,8 +75,19 @@ class InferenceEngineV2:
         # telemetry: a monitor.TelemetryCollector; the scheduler emits its
         # gauges through it and step() adds serving rates (ISSUE 1 tentpole)
         self.telemetry = telemetry
+        # serving resilience (ISSUE 4): admission control + load shedding in
+        # front of the manager, deadlines on an injectable clock (fault tests
+        # drive a fake one), preemption policy shared with the scheduler
+        self.resilience = self.config.serving_resilience
+        self._clock = clock if clock is not None else time.monotonic
+        self.admission = AdmissionQueue(self.resilience, clock=self._clock)
+        self._deadline_expired_total = 0
+        self._stall_streak = 0
+        self.stalls_total = 0  # lifetime watchdog trips (streaks are transient)
+        self._queue_wait_s = 0.0
         self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step,
-                                            telemetry=telemetry)
+                                            telemetry=telemetry,
+                                            resilience=self.resilience)
         self.topology = topology
         self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
         self._warn_truncated_nucleus()
@@ -126,10 +146,19 @@ class InferenceEngineV2:
                          out_specs=out_specs, check_vma=False)
 
     # ------------------------------------------------------------------ intake
-    def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]]) -> None:
-        """Enqueue requests (reference engine_v2.put:107)."""
+    def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]],
+            ttl_s: Optional[float] = None) -> None:
+        """Enqueue requests directly into the state manager (reference
+        engine_v2.put:107), bypassing the admission queue — the step()-level
+        API for callers running their own loop.  ``ttl_s`` stamps a deadline
+        that step() enforces between forwards: an expired sequence is evicted
+        (done, ``finish_reason: deadline_expired``, blocks reclaimed) before
+        the next ragged batch is scheduled."""
+        ttl = ttl_s if ttl_s is not None else self.resilience.default_ttl_s
+        deadline = self._clock() + ttl if ttl is not None else None
         for uid, prompt in zip(uids, prompts):
-            self.manager.add_sequence(int(uid), [int(t) for t in prompt])
+            self.manager.add_sequence(int(uid), [int(t) for t in prompt],
+                                      deadline=deadline)
 
     def flush(self, uid: int) -> None:
         self.manager.retire(uid)
@@ -163,6 +192,7 @@ class InferenceEngineV2:
     def step(self, greedy: bool = True) -> Dict[int, int]:
         """Run one SplitFuse step; returns {uid: sampled_token} for sequences
         that produced a next token (finished prefill or decoded)."""
+        self._expire_live()  # TTL enforcement between forwards, never mid-batch
         chunks = self.scheduler.schedule(self.manager)
         if not chunks:
             return {}
@@ -211,7 +241,14 @@ class InferenceEngineV2:
         (retired-sequence rate) and tokens/s through the ragged forward."""
         if self.telemetry is None:
             return
-        gauges = {"live_seqs": float(len(self.manager.live_uids()))}
+        gauges = {"live_seqs": float(len(self.manager.live_uids())),
+                  # resilience gauges (ISSUE 4): shed/preempt/deadline lifetime
+                  # counters + last admission wait, next to the serving rates
+                  "admission_queue_depth": float(len(self.admission)),
+                  "shed_total": float(self.admission.shed_total),
+                  "preempted_total": float(self.scheduler.preempted_total),
+                  "deadline_expired_total": float(self._deadline_expired_total),
+                  "queue_wait": float(self._queue_wait_s)}
         rps = self.telemetry.rate("v2_completed_requests",
                                   float(self.manager.completed_requests))
         if rps is not None:
@@ -354,8 +391,21 @@ class InferenceEngineV2:
             # check BEFORE allocating anything: a partial grab would strand
             # blocks on some sequences and starve the stepwise fallback
             return None
-        for seq in live:
-            self.manager.ensure_blocks(seq, seq.seen_tokens + 1 + k)
+        grown: List = []
+        try:
+            for seq in live:
+                prior = len(seq.blocks)
+                self.manager.ensure_blocks(seq, seq.seen_tokens + 1 + k)
+                grown.append((seq, prior))
+        except KVAllocationError:
+            # an injected/transient allocator failure mid-grab: roll every
+            # sequence back to its prior table so nothing is stranded, and
+            # decline — the stepwise fallback retries at finer grain
+            for seq, prior in grown:
+                if len(seq.blocks) > prior:
+                    self.manager.allocator.free(seq.blocks[prior:])
+                    seq.blocks = seq.blocks[:prior]
+            return None
 
         n = self._bucket(len(live))
         b = min(self._bucket(max(len(s.blocks) for s in live)), self.max_blocks_per_seq)
@@ -391,52 +441,354 @@ class InferenceEngineV2:
 
     # ----------------------------------------------------------- convenience
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None, greedy: bool = True) -> List[List[int]]:
+                 eos_token_id: Optional[int] = None, greedy: bool = True, *,
+                 strict: bool = True, priorities: Optional[Sequence[int]] = None,
+                 ttl_s: Optional[float] = None
+                 ) -> Union[List[List[int]], List[RequestResult]]:
         """Serve a batch to completion through the continuous-batching loop.
+
+        Requests flow through the admission queue (bounded, priority-aware,
+        load-shed under pressure — admission.py), are evicted between steps
+        once past their deadline (``ttl_s`` or the config default), and a
+        progress watchdog bounds live-but-unschedulable loops.
+
+        ``strict=True`` (default, the pre-resilience contract): returns
+        ``List[List[int]]`` of prompt+generated tokens and raises on the first
+        shed/failure/stall (:class:`ServingStalledError` carries a full state
+        snapshot).  ``strict=False``: every request runs to a terminal status
+        and the call returns per-request :class:`RequestResult` objects
+        (status in {ok, shed, deadline_expired, preempt_requeued_exhausted,
+        failed}) — one bad request no longer costs the rest of the batch.
 
         ``greedy=False`` samples with the engine config's temperature/top-k/
         top-p — still through the device-side burst (the scan carries the rng
         and an eos done-mask), so sampled serving runs at burst throughput
         rather than the one-host-roundtrip-per-token relay floor."""
         uids = list(range(len(prompts)))
-        self.put(uids, prompts)
+        results = self._serve(uids, prompts, max_new_tokens=max_new_tokens,
+                              eos_token_id=eos_token_id, greedy=greedy, strict=strict,
+                              priorities=priorities, ttl_s=ttl_s)
+        if strict:
+            return [results[u].tokens for u in uids]
+        return [results[u] for u in uids]
+
+    def _serve(self, uids: List[int], prompts: Sequence[Sequence[int]], *,
+               max_new_tokens: int, eos_token_id: Optional[int], greedy: bool,
+               strict: bool, priorities: Optional[Sequence[int]],
+               ttl_s: Optional[float]) -> Dict[int, RequestResult]:
+        my = set(uids)
+        conflict = sorted(my & set(self.manager.seqs))
+        if conflict:
+            # fail fast BEFORE any queue/manager mutation: finalization and
+            # cleanup key on uid, so a collision with a put()-registered
+            # sequence would otherwise let this call evict foreign work
+            raise ValueError(f"generate() uids {conflict} are already tracked (direct "
+                             f"put() requests coexist with generate() only with "
+                             f"disjoint uids); flush them first")
+        for uid in uids:
+            # reusing a retired/flushed uid is legitimate; a failure entry left
+            # over from its previous life must not poison the fresh request
+            self.manager.failures.pop(uid, None)
+        results: Dict[int, RequestResult] = {}
         produced = {u: 0 for u in uids}
-        done = set()
-        while len(done) < len(uids):
+        token_cap = self.manager.max_blocks_per_seq * self.manager.block_size
+        try:
+            # ---- admission: shed-or-queue BEFORE any KV allocation
+            for i, (uid, prompt) in enumerate(zip(uids, prompts)):
+                shed = self.admission.submit(
+                    uid, [int(t) for t in prompt],
+                    priority=priorities[i] if priorities is not None else 0,
+                    ttl_s=ttl_s, kv_utilization=self.manager.kv_utilization(),
+                    token_cap=token_cap)
+                if shed is not None:
+                    self._record_resilience("serving_shed", uid=uid, code=shed.code,
+                                            retryable=shed.retryable, detail=shed.detail)
+                    if strict:
+                        raise RuntimeError(f"request {uid} shed: {shed}")
+                    results[uid] = RequestResult(uid=uid, status=SHED, reason=str(shed),
+                                                 retryable=shed.retryable)
+            self._serve_loop(uids, my, results, produced, max_new_tokens=max_new_tokens,
+                             eos_token_id=eos_token_id, greedy=greedy, strict=strict)
+        except Exception:
+            # a strict-mode raise must not leak this call's queued tickets or
+            # live sequences into the next call (they would decode unbounded
+            # with nobody tracking their budget)
+            self._abandon(my, results)
+            raise
+        return results
+
+    def _serve_loop(self, uids: List[int], my: set, results: Dict[int, RequestResult],
+                    produced: Dict[int, int], *, max_new_tokens: int,
+                    eos_token_id: Optional[int], greedy: bool, strict: bool) -> None:
+        cfg = self.resilience
+        stall_streak = 0
+        last_sig = None
+        while any(u not in results for u in uids):
+            self._expire_live()
+            self._pump_admissions(my, results, strict)
+
             # pure-decode fast path: burst k steps on device (greedy or
-            # sampled; eos-aware via the carried done-mask)
-            live = [u for u in uids if u not in done]
+            # sampled; eos-aware via the carried done-mask).  The pump just
+            # ran, so anything still queued could NOT be admitted this
+            # iteration — bursting doesn't delay fusion, provided the burst
+            # is SLICED so admission latency (and deadline-eviction
+            # overshoot) stays bounded to a few tokens instead of paying the
+            # per-token host round-trip for a whole backpressure window.
+            live = [u for u in uids if u not in results]
             k = min((max_new_tokens - produced[u] for u in live), default=0)
+            # ALL live sequences, not just this call's: a coexisting direct
+            # put(ttl_s=...) sequence rides the burst too, and its deadline
+            # deserves the same bounded overshoot
+            if len(self.admission) or any(s.deadline is not None and not s.done
+                                          for s in self.manager.seqs.values()):
+                k = min(k, self.BURST_DEADLINE_SLICE)
             if k >= 2:
                 burst = self.decode_burst(k, greedy=greedy, eos_token_id=eos_token_id)
                 if burst:
                     for uid, toks in burst.items():
+                        if uid not in my or uid in results:
+                            continue
                         produced[uid] += len(toks)
-                        hit_eos = eos_token_id is not None and toks and toks[-1] == eos_token_id
+                        hit_eos = (eos_token_id is not None and toks
+                                   and toks[-1] == eos_token_id)
                         if hit_eos or produced[uid] >= max_new_tokens:
-                            self.manager.seqs[uid].done = True
-                            done.add(uid)
+                            self._finish_ok(uid, results,
+                                            "eos" if hit_eos else "max_new_tokens")
                     continue
+
             stepped = self.step(greedy=greedy)
+
             for uid, reason in list(self.manager.failures.items()):
-                if uid not in done:
-                    raise RuntimeError(f"request {uid} failed: {reason}")
-            if not stepped and not any(self.manager.seqs[u].pending_tokens > 0
-                                       and not self.manager.seqs[u].done
-                                       for u in uids if u not in done):
-                break
-            if not stepped:
-                live = [u for u in uids if u not in done]
-                raise RuntimeError(
-                    f"scheduler made no progress with {len(live)} live sequences — KV pool "
-                    f"exhausted ({self.manager.allocator.free_blocks} free blocks); enlarge "
-                    f"num_blocks or lower concurrency")
+                if uid in my and uid not in results:
+                    if strict:
+                        raise RuntimeError(f"request {uid} failed: {reason}")
+                    self._record_resilience("serving_request_failed", uid=uid,
+                                            reason=reason)
+                    seq = self.manager.seqs.get(uid)
+                    results[uid] = RequestResult(
+                        uid=uid, status=FAILED, reason=reason,
+                        tokens=list(seq.tokens) if seq is not None else [])
+                    if seq is not None:
+                        self.manager.retire(uid, completed=False)
+                    # consume the entry: uids are reused across generate()
+                    # calls and a stale failure must not taint a fresh request
+                    self.manager.failures.pop(uid, None)
+
+            # sequences finished WITHOUT emitting this step: a decode capped at
+            # max_blocks_per_seq completes gracefully (length_capped — all its
+            # generated tokens are valid), an expired request was evicted by
+            # _expire_live, an exhausted preemption victim ends
+            for uid in list(self.manager.seqs):
+                if uid not in my or uid in results:
+                    continue
+                seq = self.manager.seqs[uid]
+                if not (seq.done and seq.finish_reason):
+                    continue
+                if seq.finish_reason == DEADLINE_EXPIRED:
+                    if strict:
+                        raise RuntimeError(f"request {uid} deadline_expired after "
+                                           f"producing {seq.generated_tokens} tokens")
+                    results[uid] = RequestResult(uid=uid, status=DEADLINE_EXPIRED,
+                                                 tokens=list(seq.tokens), retryable=True,
+                                                 reason="deadline expired while running",
+                                                 queue_wait_s=seq.queue_wait_s,
+                                                 preemptions=seq.preemptions)
+                    self.manager.retire(uid, completed=False)
+                elif seq.finish_reason == PREEMPT_REQUEUED_EXHAUSTED:
+                    self._record_resilience("serving_preempt_requeued_exhausted",
+                                            uid=uid, preemptions=seq.preemptions)
+                    if strict:
+                        raise RuntimeError(
+                            f"request {uid} preempted {seq.preemptions}x and evicted "
+                            f"(KV pool pressure); enlarge num_blocks or lower concurrency")
+                    results[uid] = RequestResult(
+                        uid=uid, status=PREEMPT_REQUEUED_EXHAUSTED,
+                        tokens=list(seq.tokens), retryable=True,
+                        reason=f"preempted {seq.preemptions}x under KV pressure",
+                        preemptions=seq.preemptions, queue_wait_s=seq.queue_wait_s)
+                    self.manager.retire(uid, completed=False)
+                else:  # length_capped: a graceful completion
+                    self._finish_ok(uid, results, seq.finish_reason)
+
             for uid, tok in stepped.items():
+                if uid not in my or uid in results:
+                    continue
                 produced[uid] += 1
-                if produced[uid] >= max_new_tokens or (eos_token_id is not None and tok == eos_token_id):
-                    self.manager.seqs[uid].done = True
-                    done.add(uid)
-        outs = [list(self.manager.seqs[u].tokens) for u in uids]
-        for u in uids:
-            self.flush(u)
-        return outs
+                if produced[uid] >= max_new_tokens or (eos_token_id is not None
+                                                       and tok == eos_token_id):
+                    self._finish_ok(uid, results,
+                                    "eos" if (eos_token_id is not None
+                                              and tok == eos_token_id)
+                                    else "max_new_tokens")
+
+            # ---- progress watchdog: a live-but-unschedulable engine must trip,
+            # not spin.  The signature covers every observable scheduling input;
+            # identical signatures for the watchdog window = stall.
+            sig = self._progress_signature()
+            stall_streak = stall_streak + 1 if sig == last_sig else 0
+            last_sig = sig
+            self._stall_streak = stall_streak
+            if stall_streak >= cfg.stall_watchdog_steps:
+                self._handle_stall(my, results, strict)
+                stall_streak, last_sig = 0, None
+                self._stall_streak = 0
+
+    def _abandon(self, my: set, results: Dict[int, RequestResult]) -> None:
+        """Strict-mode raise cleanup: reclaim every trace of this call so the
+        engine is immediately reusable (blocks freed, queue drained, stale
+        failure entries consumed)."""
+        for uid in list(self.manager.seqs):
+            if uid in my:
+                self.manager.retire(uid, completed=False)
+        for uid in my:
+            self.manager.failures.pop(uid, None)
+        self.admission.drain()
+        self._stall_streak = 0  # the wedge was evicted with everything else
+
+    # ------------------------------------------------- serving-loop internals
+    def _finish_ok(self, uid: int, results: Dict[int, RequestResult],
+                   finish_reason: str) -> None:
+        seq = self.manager.seqs[uid]
+        seq.done = True
+        seq.finish_reason = finish_reason
+        results[uid] = RequestResult(uid=uid, status=OK, tokens=list(seq.tokens),
+                                     finish_reason=finish_reason,
+                                     queue_wait_s=seq.queue_wait_s,
+                                     preemptions=seq.preemptions)
+        self.manager.retire(uid)  # reclaim KV blocks immediately, not at batch end
+
+    def _expire_live(self) -> None:
+        """Engine-wide deadline enforcement between forwards: any live
+        sequence past its deadline — however it was admitted (generate's
+        admission pump or a direct put(ttl_s=...)) — is evicted in place:
+        done, ``finish_reason: deadline_expired``, KV blocks reclaimed.  The
+        serve loop converts evicted sequences into results; step()-level
+        callers observe ``done`` + the finish reason."""
+        now = self._clock()
+        for seq in list(self.manager.seqs.values()):
+            if seq.done or seq.deadline is None or now < seq.deadline:
+                continue
+            self.manager.evict(seq, DEADLINE_EXPIRED)
+            self._deadline_expired_total += 1
+            self._record_resilience("serving_deadline_expired", uid=seq.uid,
+                                    produced=seq.generated_tokens,
+                                    seen_tokens=seq.seen_tokens)
+
+    def _pump_admissions(self, my: set, results: Dict[int, RequestResult],
+                         strict: bool) -> bool:
+        """Move queued tickets into the state manager while the pool has
+        headroom; tickets that expired waiting become deadline_expired results
+        without ever owning a block.  Returns True when tickets remain queued
+        because the pump has no headroom (live cap / pool pressure) — the
+        serve loop may then burst, since nothing could fuse anyway."""
+        cfg = self.resilience
+        while len(self.admission):
+            live = self.manager.live_uids()
+            if cfg.max_live_seqs and len(live) >= cfg.max_live_seqs:
+                return True
+            if live and self.manager.kv_utilization() >= cfg.shed_kv_utilization:
+                return True  # pool pressure: hold the queue (progress guaranteed
+                # — something is live, and retiring it reopens the pump)
+            ticket, expired = self.admission.pop_ready()
+            for t in expired:
+                if t.uid in my and t.uid not in results:
+                    self._deadline_expired_total += 1
+                    self._record_resilience("serving_deadline_expired", uid=t.uid,
+                                            produced=0, queued=True)
+                    if strict:
+                        raise RuntimeError(f"request {t.uid} deadline_expired while queued")
+                    results[t.uid] = RequestResult(
+                        uid=t.uid, status=DEADLINE_EXPIRED, retryable=True,
+                        reason="deadline expired in the admission queue")
+            if ticket is None:
+                break
+            wait = max(0.0, self._clock() - ticket.enqueue_t)
+            self._queue_wait_s = wait
+            self.manager.add_sequence(ticket.uid, ticket.prompt,
+                                      priority=ticket.priority,
+                                      deadline=ticket.deadline, queue_wait_s=wait)
+        return False
+
+    def _handle_stall(self, my: set, results: Dict[int, RequestResult],
+                      strict: bool) -> None:
+        cfg = self.resilience
+        self.stalls_total += 1
+        snapshot = self.state_snapshot()
+        self._record_resilience("serving_stall",
+                                live_seqs=len(snapshot["live_uids"]),
+                                free_blocks=snapshot["free_blocks"],
+                                queue_depth=snapshot["queue_depth"])
+        if strict:
+            raise ServingStalledError(
+                f"serving made no progress for {cfg.stall_watchdog_steps} consecutive "
+                f"steps with {len(snapshot['live_uids'])} live sequences and "
+                f"{snapshot['free_blocks']} free KV blocks — see .snapshot for the "
+                f"full engine state", snapshot)
+        # non-strict: fail the stuck requests (live AND still-queued) with the
+        # snapshot attached, reclaim their blocks, and keep serving the rest
+        reason = (f"stalled: no scheduling progress for "
+                  f"{cfg.stall_watchdog_steps} steps")
+        for uid in list(self.manager.seqs):
+            if uid in my and uid not in results:
+                seq = self.manager.seqs[uid]
+                results[uid] = RequestResult(uid=uid, status=FAILED, reason=reason,
+                                             tokens=list(seq.tokens), retryable=True,
+                                             preemptions=seq.preemptions,
+                                             queue_wait_s=seq.queue_wait_s)
+                self.manager.retire(uid, completed=False)
+        for ticket in self.admission.drain():
+            if ticket.uid in my and ticket.uid not in results:
+                results[ticket.uid] = RequestResult(uid=ticket.uid, status=FAILED,
+                                                    reason=reason + " (still queued)",
+                                                    retryable=True)
+
+    def _progress_signature(self):
+        return (tuple(sorted((uid, s.seen_tokens, len(s.tokens), s.done)
+                             for uid, s in self.manager.seqs.items())),
+                len(self.admission), self.manager.allocator.free_blocks)
+
+    def _record_resilience(self, event: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.record_resilience(event, step=self.scheduler.steps, **fields)
+
+    # ------------------------------------------------------------ introspection
+    def state_snapshot(self) -> Dict[str, Any]:
+        """Full serving state for stall diagnostics: live uids, per-sequence
+        progress and block-table occupancy, allocator free count, queue depth."""
+        alloc = self.manager.allocator
+        return {
+            "live_uids": sorted(self.manager.seqs),
+            "sequences": {uid: {"seen_tokens": s.seen_tokens,
+                                "pending_tokens": s.pending_tokens,
+                                "blocks": list(s.blocks),
+                                "done": s.done,
+                                "preemptions": s.preemptions,
+                                "deadline": s.deadline}
+                          for uid, s in self.manager.seqs.items()},
+            "free_blocks": alloc.free_blocks,
+            "num_blocks": alloc.num_blocks,
+            "queue_depth": len(self.admission),
+            "scheduler_steps": self.scheduler.steps,
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness snapshot for external probes (the serving analog of the
+        training engine's telemetry record): pool state, queue depth, and the
+        lifetime resilience counters."""
+        return {
+            "live_seqs": len(self.manager.live_uids()),
+            "queue_depth": len(self.admission),
+            "free_blocks": self.manager.allocator.free_blocks,
+            "kv_utilization": self.manager.kv_utilization(),
+            "scheduler_steps": self.scheduler.steps,
+            "completed_total": self.manager.completed_requests,
+            "failed_total": self.manager.failed_requests,
+            "shed_total": self.admission.shed_total,
+            "preempted_total": self.scheduler.preempted_total,
+            "deadline_expired_total": self._deadline_expired_total,
+            # the streak is a live gauge; stalls_total is the observable stall
+            # signal (the streak resets the moment the watchdog handles a trip,
+            # so a momentary `stalled` boolean could never be caught True)
+            "stall_streak": self._stall_streak,
+            "stalls_total": self.stalls_total,
+        }

@@ -4,6 +4,14 @@ Analog of DSStateManager / DSSequenceDescriptor (inference/v2/ragged/
 ragged_manager.py:19, sequence_descriptor.py): tracks live sequences, grows
 their block tables as tokens are scheduled, and frees blocks at retirement.
 All host-side (numpy); the device sees only the padded block-table array.
+
+Resilience hooks (ISSUE 4): sequences carry admission metadata (arrival order,
+priority, deadline, preemption count), :meth:`RaggedStateManager.preempt`
+rolls a prefilling victim back to a block boundary so its KV blocks can rescue
+starved decodes, and the intake/retire edges validate loudly —
+:class:`EmptyPromptError` for a request that could never be scheduled,
+:class:`UnknownSequenceError` (with the uid's actual history) instead of a
+bare ``KeyError`` on a bad retire.
 """
 
 import dataclasses
@@ -13,6 +21,31 @@ import numpy as np
 
 from .blocked_allocator import BlockedAllocator
 
+# finish reasons that mark an EVICTION (the request did not run to a useful
+# completion); retire() excludes them from completed_requests even when the
+# caller flushes through the default completed=True path
+EVICTED_FINISH_REASONS = frozenset({"deadline_expired", "preempt_requeued_exhausted"})
+
+
+class EmptyPromptError(ValueError):
+    """A request arrived with zero prompt tokens.  Such a sequence has
+    ``pending_tokens == 0`` forever: the scheduler never picks it, it never
+    retires, and ``generate()`` would spin on it — reject at intake."""
+
+    def __init__(self, uid: int):
+        super().__init__(f"uid {uid}: empty prompt — a sequence with no pending "
+                         f"tokens can never be scheduled or retired")
+        self.uid = uid
+
+
+class UnknownSequenceError(KeyError):
+    """Retire/lookup of a uid the manager does not track, with its history
+    (already retired / failed-and-flushed / never added) in the message."""
+
+    def __init__(self, uid: int, detail: str):
+        super().__init__(f"uid {uid} is not tracked by RaggedStateManager ({detail})")
+        self.uid = uid
+
 
 @dataclasses.dataclass
 class SequenceDescriptor:
@@ -21,6 +54,14 @@ class SequenceDescriptor:
     seen_tokens: int = 0  # tokens already in the KV cache
     blocks: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # --- admission / resilience metadata (inference/v2/admission.py) ---
+    prompt_len: int = 0        # len(tokens) at intake; generated = len(tokens) - prompt_len
+    arrival: int = 0           # monotonic intake order; preemption evicts the newest
+    priority: int = 0          # lower = more urgent (admission-queue order)
+    deadline: Optional[float] = None  # absolute clock time; engine evicts past it
+    queue_wait_s: float = 0.0  # time spent in the admission queue
+    preemptions: int = 0       # times this sequence was preempted-and-requeued
+    finish_reason: Optional[str] = None  # eos | max_new_tokens | length_capped | ...
 
     @property
     def pending_tokens(self) -> int:
@@ -29,6 +70,10 @@ class SequenceDescriptor:
     @property
     def in_prefill(self) -> bool:
         return self.seen_tokens < len(self.tokens) - 1
+
+    @property
+    def generated_tokens(self) -> int:
+        return len(self.tokens) - self.prompt_len
 
 
 class RaggedStateManager:
@@ -39,20 +84,34 @@ class RaggedStateManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self.failures: Dict[int, str] = {}
+        # uid history for descriptive retire errors; a bounded recency window
+        # (insertion-ordered dict) so a long-lived server doesn't grow it
+        # forever — uids older than the window degrade to "never added"
+        self.retired_uids: Dict[int, None] = {}
+        self._retired_window = 4096
         # lifetime counters feeding the telemetry gauges (requests/sec is the
         # collector-side rate over completed_requests)
         self.total_requests = 0
         self.completed_requests = 0
         self.failed_requests = 0
+        self._arrivals = 0
 
     @property
     def trash_block(self) -> int:
         return self.allocator.trash_block
 
-    def add_sequence(self, uid: int, prompt_tokens: List[int]) -> SequenceDescriptor:
+    def add_sequence(self, uid: int, prompt_tokens: List[int], *, priority: int = 0,
+                     deadline: Optional[float] = None,
+                     queue_wait_s: float = 0.0) -> SequenceDescriptor:
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
-        seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens))
+        if not prompt_tokens:
+            raise EmptyPromptError(uid)
+        seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens),
+                                 prompt_len=len(prompt_tokens), arrival=self._arrivals,
+                                 priority=priority, deadline=deadline,
+                                 queue_wait_s=queue_wait_s)
+        self._arrivals += 1
         self.seqs[uid] = seq
         self.total_requests += 1
         return seq
@@ -78,6 +137,31 @@ class RaggedStateManager:
             self.allocator.free(seq.blocks)  # reclaim the KV pool immediately
             seq.blocks = []
 
+    def evict(self, seq: SequenceDescriptor, finish_reason: str) -> None:
+        """End a sequence WITHOUT completion: done + finish reason + KV blocks
+        reclaimed in place.  The single primitive behind deadline expiry and
+        preemption-budget exhaustion, so reason-aware accounting (retire()
+        excludes EVICTED_FINISH_REASONS from completed_requests) has one seam."""
+        seq.done = True
+        seq.finish_reason = finish_reason
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+            seq.blocks = []
+
+    def preempt(self, seq: SequenceDescriptor, keep_blocks: int = 0) -> int:
+        """Preempt-and-requeue support: free the sequence's trailing KV blocks
+        and roll ``seen_tokens`` back to the kept-block boundary.  The prefix
+        KV in the kept blocks stays valid (prefill wrote those positions and
+        they are never rewritten); the dropped positions are simply recomputed
+        when the sequence is rescheduled.  Returns the number of freed blocks."""
+        keep_blocks = max(0, min(int(keep_blocks), len(seq.blocks)))
+        dropped = seq.blocks[keep_blocks:]
+        if dropped:
+            self.allocator.free(dropped)
+            seq.blocks = seq.blocks[:keep_blocks]
+        seq.seen_tokens = min(seq.seen_tokens, keep_blocks * self.block_size)
+        return len(dropped)
+
     def can_allocate(self, n_blocks: int) -> bool:
         return self.allocator.free_blocks >= n_blocks
 
@@ -90,10 +174,31 @@ class RaggedStateManager:
         row[:len(seq.blocks)] = seq.blocks
         return row
 
-    def retire(self, uid: int) -> None:
-        seq = self.seqs.pop(uid)
+    def retire(self, uid: int, *, completed: bool = True) -> None:
+        """Drop a sequence and reclaim its blocks.  ``completed=False`` marks
+        an eviction (deadline/shed/stall) so it doesn't count as a completion.
+        Unknown uids raise :class:`UnknownSequenceError` naming what actually
+        happened to the uid instead of a bare ``KeyError``."""
+        seq = self.seqs.pop(uid, None)
+        if seq is None:
+            if uid in self.failures:
+                detail = f"it failed ({self.failures[uid]!r})"
+                if uid in self.retired_uids:
+                    detail += " and was already flushed"
+            elif uid in self.retired_uids:
+                detail = "it was already retired"
+            else:
+                detail = "it was never added"
+            raise UnknownSequenceError(uid, detail)
+        self.retired_uids.pop(uid, None)  # re-adding refreshes recency
+        self.retired_uids[uid] = None
+        while len(self.retired_uids) > self._retired_window:
+            self.retired_uids.pop(next(iter(self.retired_uids)))
         self.allocator.free(seq.blocks)
-        if uid not in self.failures:  # a flushed failure is not a completion
+        seq.blocks = []
+        # neither a flushed failure nor an evicted request is a completion
+        if (completed and uid not in self.failures
+                and seq.finish_reason not in EVICTED_FINISH_REASONS):
             self.completed_requests += 1
 
     def live_uids(self) -> List[int]:

@@ -6,11 +6,23 @@ runs a fixed token budget; decoding sequences contribute 1 token each, the
 remaining budget is filled with prompt CHUNKS (long prompts are split across
 steps — "split"), and prompts co-run with decodes in one ragged batch
 ("fuse").  Fixed-size steps keep forward latency flat and the MXU saturated.
+
+Resilience (ISSUE 4): a decode-starvation guard with KV-pressure preemption —
+a decode that cannot reserve its one block reclaims capacity from the NEWEST
+prefilling sequence, which is rolled back to a block boundary (prefix KV kept)
+and requeued; a victim preempted past ``max_preemptions`` is evicted with
+finish reason ``preempt_requeued_exhausted``.  A decoding sequence that hits
+``max_blocks_per_seq`` now completes gracefully (``length_capped`` — every
+generated token is valid) instead of being hard-failed mid-generation, and
+injected/transient :class:`KVAllocationError`s degrade to "chunk skipped this
+step" instead of detonating the whole step.
 """
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
+from ...runtime.config import ServingResilienceConfig
+from .blocked_allocator import KVAllocationError
 from .ragged_manager import RaggedStateManager, SequenceDescriptor
 
 
@@ -23,20 +35,26 @@ class ScheduledChunk:
 class SplitFuseScheduler:
 
     def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64,
-                 telemetry=None):
+                 telemetry=None, resilience: Optional[ServingResilienceConfig] = None):
         self.token_budget = token_budget
         self.max_seqs = max_seqs_per_step
         # TelemetryCollector (monitor/telemetry.py); every schedule() emits
         # the scheduler gauges through it when attached
         self.telemetry = telemetry
+        self.resilience = resilience if resilience is not None else ServingResilienceConfig()
         self.steps = 0
+        self.preempted_total = 0
         self.last_gauges: Dict[str, float] = {}
+        self._requeued: set = set()  # victims preempted THIS step (skip their prefill)
+        self._reserve_faulted = False  # last _reserve failed on an injected/transient
+        # allocator fault (pool may have room) rather than genuine exhaustion
 
     def schedule(self, manager: RaggedStateManager) -> List[ScheduledChunk]:
         """Pick this step's ragged batch. Decodes first (latency), then prompt
         chunks to fill the budget; respects KV-pool availability."""
         budget = self.token_budget
         chunks: List[ScheduledChunk] = []
+        self._requeued = set()
         decoding, prefilling = [], []
         for uid in manager.live_uids():
             seq = manager.seqs[uid]
@@ -44,19 +62,34 @@ class SplitFuseScheduler:
                 continue
             (prefilling if seq.pending_tokens > 1 else decoding).append(seq)
 
+        starved: List[SequenceDescriptor] = []
         for seq in decoding:
             if budget <= 0 or len(chunks) >= self.max_seqs:
                 break
             if not self._reserve(manager, seq, 1):
+                # pool-tight (not capped/failed) decodes are preemption-
+                # rescuable; a transient allocator FAULT is not exhaustion —
+                # retry next step instead of punishing an innocent prefill
+                if not seq.done and not self._reserve_faulted:
+                    starved.append(seq)
                 continue
             chunks.append(ScheduledChunk(seq.uid, 1))
             budget -= 1
 
+        if starved and self.resilience.preemption:
+            budget = self._rescue_starved_decodes(manager, starved, prefilling,
+                                                  chunks, budget)
+
         for seq in prefilling:
             if budget <= 0 or len(chunks) >= self.max_seqs:
                 break
+            if seq.done or seq.uid in self._requeued:
+                continue  # evicted, or preempted-and-requeued this very step
             take = min(seq.pending_tokens, budget)
             while take > 0 and not seq.done and not self._reserve(manager, seq, take):
+                if self._reserve_faulted:
+                    take = 0  # transient fault: retry next step at full size
+                    break
                 take //= 2  # shrink the chunk if the KV pool is tight
             if take <= 0 or seq.done:
                 continue
@@ -64,6 +97,62 @@ class SplitFuseScheduler:
             budget -= take
         self._emit_gauges(manager, chunks, len(decoding), len(prefilling))
         return chunks
+
+    # ---------------------------------------------- decode-starvation guard
+    def _rescue_starved_decodes(self, manager: RaggedStateManager,
+                                starved: List[SequenceDescriptor],
+                                prefilling: List[SequenceDescriptor],
+                                chunks: List[ScheduledChunk], budget: int) -> int:
+        """KV-pressure preemption: a decode that could not reserve its single
+        block reclaims capacity from the newest prefilling victim.  Victims
+        lose their trailing half of blocks per preemption (rolled back to the
+        kept-block boundary, requeued for later steps); a victim already at
+        ``max_preemptions`` is instead evicted outright so decodes — which
+        hold completed prefill work — never starve behind fresh prompts."""
+        scheduled = {c.uid for c in chunks}
+        max_preempt = self.resilience.max_preemptions
+        for seq in starved:
+            if budget <= 0 or len(chunks) >= self.max_seqs:
+                break
+            rescued = False
+            while not rescued:
+                if self._reserve(manager, seq, 1):
+                    rescued = True
+                    break
+                if self._reserve_faulted:
+                    break  # fault, not pressure: no victim deserves preemption
+                victims = [p for p in prefilling
+                           if p.blocks and not p.done and p.uid not in scheduled]
+                fresh = [p for p in victims if p.preemptions < max_preempt]
+                if fresh:
+                    victim = max(fresh, key=lambda s: s.arrival)
+                    keep = len(victim.blocks) // 2
+                    freed = manager.preempt(victim, keep_blocks=keep)
+                    victim.preemptions += 1
+                    self.preempted_total += 1
+                    self._requeued.add(victim.uid)
+                    self._record("serving_preempt", uid=victim.uid, freed_blocks=freed,
+                                 rolled_back_to=victim.seen_tokens,
+                                 preemptions=victim.preemptions)
+                elif victims:
+                    # every candidate exhausted its requeue budget: evict the
+                    # newest one for good rather than deadlock the decodes
+                    victim = max(victims, key=lambda s: s.arrival)
+                    freed = len(victim.blocks)
+                    manager.evict(victim, "preempt_requeued_exhausted")
+                    self.preempted_total += 1
+                    self._record("serving_preempt_exhausted", uid=victim.uid,
+                                 freed_blocks=freed, preemptions=victim.preemptions)
+                else:
+                    break  # nothing left to reclaim; the stall watchdog owns this
+            if rescued:
+                chunks.append(ScheduledChunk(seq.uid, 1))
+                budget -= 1
+        return budget
+
+    def _record(self, event: str, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.record_resilience(event, step=self.steps, **fields)
 
     def _emit_gauges(self, manager: RaggedStateManager, chunks: List[ScheduledChunk],
                      n_decoding: int, n_prefilling: int) -> None:
@@ -79,22 +168,34 @@ class SplitFuseScheduler:
             "scheduled_tokens": float(scheduled_tokens),
             "token_occupancy": scheduled_tokens / max(self.token_budget, 1),
             "kv_block_utilization": manager.kv_utilization(),
+            "preempted_total": float(self.preempted_total),
         }
         self.steps += 1
         if self.telemetry is not None:
             self.telemetry.record_gauges(self.last_gauges, step=self.steps,
                                          prefix="Inference/Scheduler")
 
-    @staticmethod
-    def _reserve(manager: RaggedStateManager, seq: SequenceDescriptor, n: int) -> bool:
+    def _reserve(self, manager: RaggedStateManager, seq: SequenceDescriptor, n: int) -> bool:
+        self._reserve_faulted = False
         upto = seq.seen_tokens + n
         if manager.over_cap(upto):
-            # fail just this sequence (reference: request rejection), not the step
-            manager.fail(seq.uid, f"needs {upto} tokens > "
-                         f"{manager.max_blocks_per_seq * manager.block_size} cap")
+            if seq.generated_tokens > 0:
+                # mid-generation cap: every token generated so far is valid
+                # (sampled from real logits), so complete gracefully instead
+                # of hard-failing the request (reference: max-length finish)
+                seq.done = True
+                seq.finish_reason = "length_capped"
+            else:
+                # the PROMPT itself cannot fit — a genuine rejection
+                manager.fail(seq.uid, f"needs {upto} tokens > "
+                             f"{manager.max_blocks_per_seq * manager.block_size} cap")
             return False
         need = manager.blocks_needed(seq, upto)
         if need and not manager.can_allocate(need):
             return False
-        manager.ensure_blocks(seq, upto)
+        try:
+            manager.ensure_blocks(seq, upto)
+        except KVAllocationError:
+            self._reserve_faulted = True
+            return False  # transient/injected pool failure: retry a later step
         return True

@@ -1,0 +1,117 @@
+"""Failpoint-style fault injection for the serving resilience layer.
+
+The training-side sibling (tests/unit/fault_injection.py) plays a dying host
+at the checkpoint-engine seam; this one plays overload and silent wedges at
+the three seams the v2 serving engine must survive (ISSUE 4):
+
+- :class:`FaultyBlockedAllocator` — the KV pool fails allocations on command
+  (probabilistic with a seeded RNG, or deterministically every N-th call).
+  The scheduler must degrade to "chunk skipped this step", the decode burst
+  must roll back partial grabs, and the run must still finish.
+- :class:`FrozenSequenceInjector` — a sequence whose device results are lost
+  every step (the live-but-unschedulable wedge): progress is rolled back after
+  each ``engine.step()``.  Only the stall watchdog can end it.
+- :class:`FakeClock` — deterministic monotonic time for deadline/TTL tests;
+  injected via ``InferenceEngineV2(clock=...)``.
+
+Used by tests/unit/inference/test_serving_resilience.py and the
+``make serving-resilience-smoke`` CI target.
+"""
+
+import random
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator, KVAllocationError
+
+
+class FakeClock:
+    """Deterministic clock: each call returns the current time then advances
+    it by ``tick`` (so a serving loop experiences passing wall-time without
+    sleeping); ``advance`` jumps explicitly."""
+
+    def __init__(self, start: float = 0.0, tick: float = 0.0):
+        self.t = float(start)
+        self.tick = float(tick)
+        self.calls = 0
+
+    def __call__(self) -> float:
+        self.calls += 1
+        now = self.t
+        self.t += self.tick
+        return now
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class FaultyBlockedAllocator(BlockedAllocator):
+    """A KV-block allocator that fails on command.
+
+    ``fail_rate``  — each ``allocate`` call fails with this probability
+                     (seeded ``random.Random``: runs are reproducible).
+    ``fail_every`` — every N-th ``allocate`` call fails deterministically.
+
+    Failures raise :class:`KVAllocationError` — the same retryable signal a
+    genuinely exhausted pool produces — BEFORE mutating the free list, so a
+    surviving engine proves both the retry paths and that no blocks strand.
+    """
+
+    def __init__(self, num_blocks: int, *, fail_rate: float = 0.0,
+                 fail_every: int = 0, seed: int = 0):
+        super().__init__(num_blocks)
+        self.fail_rate = float(fail_rate)
+        self.fail_every = int(fail_every)
+        self._rng = random.Random(seed)
+        self.calls = 0
+        self.injected_failures = 0
+
+    def allocate(self, n: int):
+        self.calls += 1
+        if self.fail_every and self.calls % self.fail_every == 0:
+            self.injected_failures += 1
+            raise KVAllocationError(f"injected allocation failure (call #{self.calls}, "
+                                    f"every {self.fail_every})")
+        if self.fail_rate and self._rng.random() < self.fail_rate:
+            self.injected_failures += 1
+            raise KVAllocationError(f"injected allocation failure (call #{self.calls}, "
+                                    f"p={self.fail_rate})")
+        return super().allocate(n)
+
+
+class FrozenSequenceInjector:
+    """Simulates a sequence whose device results are lost every step.
+
+    On install, wraps ``engine.step``: the first time the target uid is seen
+    its progress is snapshotted, and after every subsequent step the sequence
+    is rolled back to that snapshot and its emitted token (if any) dropped.
+    The sequence stays live with pending work forever — the exact state that
+    used to spin ``generate()`` and that the progress watchdog must catch.
+    """
+
+    def __init__(self, engine, uid: int):
+        self.engine = engine
+        self.uid = uid
+        self._snap = None
+        self._orig_step = None
+
+    def install(self) -> "FrozenSequenceInjector":
+        self._orig_step = self.engine.step
+
+        def frozen_step(greedy: bool = True):
+            seq = self.engine.manager.seqs.get(self.uid)
+            if seq is not None and self._snap is None:
+                self._snap = (seq.seen_tokens, list(seq.tokens))
+            out = self._orig_step(greedy=greedy)
+            seq = self.engine.manager.seqs.get(self.uid)
+            if seq is not None and self._snap is not None and not seq.done:
+                seq.seen_tokens = self._snap[0]
+                seq.tokens = list(self._snap[1])
+                out.pop(self.uid, None)
+            return out
+
+        self.engine.step = frozen_step
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig_step is not None:
+            self.engine.step = self._orig_step
+            self._orig_step = None

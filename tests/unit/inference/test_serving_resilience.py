@@ -1,0 +1,559 @@
+"""Serving resilience suite (ISSUE 4): admission control, deadlines, load
+shedding, preemption-and-requeue, stall watchdog, and fault-injected recovery
+for the v2 ragged engine.  Fault machinery lives in
+tests/unit/fault_injection_serving.py; everything runs on the CPU backend."""
+
+import json
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2 import (BlockedAllocator, EmptyPromptError, InferenceEngineV2,
+                                        KVAllocationError, RaggedStateManager, RequestResult,
+                                        ServingStalledError, SplitFuseScheduler,
+                                        UnknownSequenceError)
+from deepspeed_tpu.inference.v2.admission import (AdmissionQueue, DEADLINE_EXPIRED, FAILED, OK,
+                                                  PREEMPT_REQUEUED_EXHAUSTED, SHED)
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.runtime.config import ServingResilienceConfig
+from tests.unit.fault_injection_serving import (FakeClock, FaultyBlockedAllocator,
+                                                FrozenSequenceInjector)
+
+
+# ------------------------------------------------------------ admission queue
+def test_admission_priority_and_fifo():
+    q = AdmissionQueue(ServingResilienceConfig())
+    assert q.submit(0, [1], priority=5) is None
+    assert q.submit(1, [1], priority=0) is None
+    assert q.submit(2, [1], priority=0) is None
+    order = []
+    while len(q):
+        ticket, expired = q.pop_ready()
+        assert not expired
+        order.append(ticket.uid)
+    assert order == [1, 2, 0]  # lower priority value first, FIFO within a class
+
+
+def test_admission_bounded_depth_sheds_retryable():
+    q = AdmissionQueue(ServingResilienceConfig(max_queue_depth=2))
+    assert q.submit(0, [1]) is None and q.submit(1, [1]) is None
+    shed = q.submit(2, [1])
+    assert shed is not None and shed.code == "queue_full" and shed.retryable
+    assert q.shed_total == 1 and len(q) == 2
+
+
+def test_admission_fatal_sheds_before_kv():
+    q = AdmissionQueue(ServingResilienceConfig())
+    empty = q.submit(0, [])
+    assert empty is not None and empty.code == "empty_prompt" and not empty.retryable
+    over = q.submit(1, list(range(100)), token_cap=64)
+    assert over is not None and over.code == "prompt_over_cap" and not over.retryable
+    assert len(q) == 0  # neither ever entered the queue
+
+
+def test_admission_kv_pressure_shed():
+    q = AdmissionQueue(ServingResilienceConfig(shed_kv_utilization=0.5))
+    assert q.submit(0, [1], kv_utilization=0.4) is None
+    shed = q.submit(1, [1], kv_utilization=0.6)
+    assert shed is not None and shed.code == "kv_pressure" and shed.retryable
+    # threshold 1.0 disables pressure shedding entirely
+    q2 = AdmissionQueue(ServingResilienceConfig())
+    assert q2.submit(0, [1], kv_utilization=1.0) is None
+
+
+def test_admission_queue_expiry_on_pop():
+    clock = FakeClock()
+    q = AdmissionQueue(ServingResilienceConfig(), clock=clock)
+    q.submit(0, [1], ttl_s=1.0)
+    q.submit(1, [1])  # no TTL
+    clock.advance(2.0)
+    ticket, expired = q.pop_ready()
+    assert [t.uid for t in expired] == [0]
+    assert ticket is not None and ticket.uid == 1
+
+
+# --------------------------------------------------- manager/allocator edges
+def test_manager_rejects_empty_prompt():
+    m = RaggedStateManager(num_blocks=8, block_size=4, max_blocks_per_seq=4)
+    with pytest.raises(EmptyPromptError, match="uid 3: empty prompt"):
+        m.add_sequence(3, [])
+    assert 3 not in m.seqs and m.total_requests == 0
+
+
+def test_retire_unknown_uid_is_descriptive():
+    m = RaggedStateManager(num_blocks=8, block_size=4, max_blocks_per_seq=4)
+    with pytest.raises(UnknownSequenceError, match="never added"):
+        m.retire(99)
+    m.add_sequence(1, [1, 2, 3])
+    m.retire(1)
+    with pytest.raises(UnknownSequenceError, match="already retired"):
+        m.retire(1)
+    m.add_sequence(2, [1, 2, 3])
+    m.fail(2, "boom")
+    m.retire(2)  # flushing a failure is legal once
+    with pytest.raises(UnknownSequenceError, match="failed .*boom"):
+        m.retire(2)
+
+
+def test_allocator_double_free_guard():
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    a.free(got[:1])
+    with pytest.raises(ValueError, match="double free"):
+        a.free(got[:1])
+    with pytest.raises(ValueError, match="double free"):
+        a.free([got[1], got[1]])  # duplicate ids WITHIN one call alias too
+    a.free([got[1]])  # the failed call must not have mutated state
+    with pytest.raises(KVAllocationError):  # subclass of RuntimeError (compat)
+        a.allocate(100)
+    assert issubclass(KVAllocationError, RuntimeError)
+
+
+def test_manager_preempt_rolls_back_to_block_boundary():
+    m = RaggedStateManager(num_blocks=16, block_size=4, max_blocks_per_seq=8)
+    seq = m.add_sequence(1, list(range(20)))
+    seq.seen_tokens = 14
+    m.ensure_blocks(seq, 14)  # 4 blocks
+    freed = m.preempt(seq, keep_blocks=2)
+    assert freed == 2 and len(seq.blocks) == 2
+    assert seq.seen_tokens == 8  # kept-block boundary, not mid-block
+    freed = m.preempt(seq, keep_blocks=0)
+    assert freed == 2 and seq.blocks == [] and seq.seen_tokens == 0
+
+
+# ----------------------------------------------- KV-pool exhaustion coverage
+def test_prefill_chunk_halves_under_pool_pressure():
+    """The `_reserve returning False -> take //= 2` path schedules a smaller
+    chunk instead of failing the request when the pool is tight."""
+    m = RaggedStateManager(num_blocks=6, block_size=4, max_blocks_per_seq=8)  # 5 usable
+    hog = m.add_sequence(1, list(range(16)))
+    m.ensure_blocks(hog, 16)  # 4 blocks -> 1 free
+    hog.seen_tokens = 16  # parked: nothing pending
+    sched = SplitFuseScheduler(token_budget=16, max_seqs_per_step=4)
+    m.add_sequence(2, list(range(16)))
+    chunks = sched.schedule(m)
+    by = {c.uid: c.n_tokens for c in chunks}
+    # 16 tokens needs 4 blocks (unavailable) -> 8 needs 2 -> 4 fits the 1 free
+    assert by == {2: 4}
+    assert 2 not in m.failures
+
+
+def test_fail_frees_blocks_reusable_same_step():
+    """Blocks freed by fail() mid-schedule are immediately reusable by the
+    next sequence within the SAME schedule() call."""
+    m = RaggedStateManager(num_blocks=3, block_size=4, max_blocks_per_seq=2)  # 2 usable, cap 8
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=4)
+    a = m.add_sequence(1, list(range(9)))  # prompt 9 > cap 8: fails at reserve
+    a.seen_tokens = 8
+    m.ensure_blocks(a, 8)  # holds both usable blocks
+    m.add_sequence(2, list(range(8)))  # needs 2 blocks; only a's freed ones
+    chunks = sched.schedule(m)
+    by = {c.uid: c.n_tokens for c in chunks}
+    assert 1 in m.failures and "cap" in m.failures[1]
+    assert by == {2: 8}  # got a's blocks in the same step
+    assert m.allocator.free_blocks == 0
+
+
+# ------------------------------------------------- graceful length capping
+def test_decoding_sequence_completes_length_capped():
+    """A DECODING sequence that hits max_blocks_per_seq finishes gracefully
+    (all generated tokens are valid) instead of being hard-failed."""
+    m = RaggedStateManager(num_blocks=16, block_size=4, max_blocks_per_seq=2)  # cap 8
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=4)
+    seq = m.add_sequence(1, [1, 2, 3, 4, 5])
+    seq.tokens += [7, 8, 9, 6]  # 4 generated -> len 9
+    seq.seen_tokens = 8         # pending 1; upto 9 > cap
+    m.ensure_blocks(seq, 8)
+    sched.schedule(m)
+    assert seq.done and seq.finish_reason == "length_capped"
+    assert 1 not in m.failures
+    # the PROMPT itself over cap is still a genuine rejection (budget > cap so
+    # the first chunk's reservation crosses the cap)
+    m2 = RaggedStateManager(num_blocks=16, block_size=4, max_blocks_per_seq=2)
+    sched2 = SplitFuseScheduler(token_budget=16, max_seqs_per_step=4)
+    m2.add_sequence(2, list(range(9)))
+    sched2.schedule(m2)
+    assert 2 in m2.failures
+
+
+def test_generate_length_capped_end_to_end():
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                            num_blocks=32, block_size=8, max_blocks_per_seq=2,
+                            token_budget=16, max_seqs_per_step=4)
+    # cap = 16 positions; prompt 5 + 32 requested would need 37
+    res = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=32, strict=False)[0]
+    assert res.status == OK and res.finish_reason == "length_capped"
+    assert len(res.tokens) == 17  # 16 cached + the final sampled token
+    # strict mode returns the tokens too (a valid completion, not an error)
+    out = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=32)
+    assert out[0] == res.tokens
+
+
+# ------------------------------------------------------ preemption / rescue
+def _starved_decode_setup():
+    m = RaggedStateManager(num_blocks=7, block_size=4, max_blocks_per_seq=8)  # 6 usable
+    d = m.add_sequence(1, list(range(9)))
+    d.seen_tokens = 8
+    m.ensure_blocks(d, 8)  # 2 blocks; next decode token needs a 3rd
+    p_old = m.add_sequence(2, list(range(20)))
+    p_old.seen_tokens = 4
+    m.ensure_blocks(p_old, 4)  # 1 block
+    p_new = m.add_sequence(3, list(range(20)))
+    p_new.seen_tokens = 12
+    m.ensure_blocks(p_new, 12)  # 3 blocks -> pool full
+    return m, d, p_old, p_new
+
+
+def test_decode_starvation_preempts_newest_prefill():
+    m, d, p_old, p_new = _starved_decode_setup()
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=8)
+    chunks = sched.schedule(m)
+    by = {c.uid: c.n_tokens for c in chunks}
+    assert by.get(1) == 1                    # the starved decode was rescued
+    assert p_new.preemptions == 1            # ...at the NEWEST prefill's expense
+    assert len(p_new.blocks) == 1 and p_new.seen_tokens == 4  # block boundary
+    assert p_old.preemptions == 0            # older prefill untouched
+    assert 3 not in by                       # victim requeued, not re-run this step
+    assert 2 in by                           # older prefill keeps making progress
+    assert sched.preempted_total == 1
+
+
+def test_preemption_exhausted_evicts_victim():
+    m, d, p_old, p_new = _starved_decode_setup()
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=8,
+                               resilience=ServingResilienceConfig(max_preemptions=0))
+    chunks = sched.schedule(m)
+    by = {c.uid: c.n_tokens for c in chunks}
+    assert by.get(1) == 1
+    assert p_new.done and p_new.finish_reason == PREEMPT_REQUEUED_EXHAUSTED
+    assert p_new.blocks == []                # fully reclaimed
+    assert 3 not in m.failures               # an eviction, not a failure
+
+
+def test_transient_allocator_fault_does_not_preempt():
+    """A transient/injected allocation fault is NOT pool exhaustion: the
+    starved decode retries next step instead of an innocent prefill being
+    preempted despite a free pool."""
+    m = RaggedStateManager(num_blocks=9, block_size=4, max_blocks_per_seq=8)
+    m.allocator = FaultyBlockedAllocator(9)  # healthy during setup
+    d = m.add_sequence(1, list(range(9)))
+    d.seen_tokens = 8
+    m.ensure_blocks(d, 8)  # 2 blocks
+    p = m.add_sequence(2, list(range(20)))
+    p.seen_tokens = 12
+    m.ensure_blocks(p, 12)  # 3 blocks -> 3 still FREE
+    m.allocator.fail_every = 1  # every allocate now faults
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=8)
+    chunks = sched.schedule(m)
+    assert 1 not in {c.uid for c in chunks}  # decode skipped this step...
+    assert sched.preempted_total == 0 and p.preemptions == 0  # ...nobody punished
+    m.allocator.fail_every = 0
+    chunks = sched.schedule(m)  # fault cleared: decode proceeds normally
+    assert 1 in {c.uid for c in chunks}
+
+
+def test_generate_rejects_uid_collision_with_put():
+    """generate()'s range-based uids must fail fast on collision with a
+    put()-registered sequence instead of evicting the foreign request."""
+    eng = _tiny_engine()
+    eng.put([0], [[1, 2, 3]])
+    with pytest.raises(ValueError, match="already tracked"):
+        eng.generate([[4, 5, 6]], max_new_tokens=2)
+    seq = eng.manager.seqs[0]
+    assert not seq.done and seq.tokens == [1, 2, 3]  # foreign work untouched
+    eng.flush(0)
+    assert eng.generate([[4, 5, 6]], max_new_tokens=2)  # disjoint again: fine
+
+
+def test_put_ttl_enforced_by_step():
+    """put(ttl_s=...) deadlines are honored by the step()-level API too:
+    the expired sequence is evicted between forwards, blocks reclaimed."""
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock)
+    initial_free = eng.manager.allocator.free_blocks
+    eng.put([7], [[1, 2, 3, 4]], ttl_s=0.3)
+    out = eng.step()  # prefill + first token, before expiry
+    assert 7 in out
+    for _ in range(12):
+        eng.step()
+    seq = eng.manager.seqs[7]
+    assert seq.done and seq.finish_reason == DEADLINE_EXPIRED
+    assert seq.blocks == []
+    eng.flush(7)
+    assert eng.manager.allocator.free_blocks == initial_free
+    assert eng.manager.completed_requests == 0  # an eviction, not a completion
+
+
+def test_stale_failure_does_not_poison_reused_uid():
+    """A failure entry left by a previous put()/flush() life of a uid must not
+    fail a fresh generate() request reusing it."""
+    eng = _tiny_engine()  # cap = 64 positions
+    eng.put([0], [list(range(1, 70))])  # over-cap prompt: fails at scheduling
+    for _ in range(3):  # budget 32/step: the cap is crossed on the third chunk
+        eng.step()
+    assert 0 in eng.manager.failures
+    eng.flush(0)
+    out = eng.generate([[1, 2, 3]], max_new_tokens=2)  # strict must not raise
+    assert out[0][:3] == [1, 2, 3] and len(out[0]) == 5
+
+
+def test_put_applies_config_default_ttl():
+    """serving_resilience.default_ttl_s applies to direct put() intake, not
+    just the generate() admission path."""
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock,
+                       config={"dtype": "float32",
+                               "serving_resilience": {"default_ttl_s": 0.3}})
+    eng.put([5], [[1, 2, 3]])
+    for _ in range(12):
+        eng.step()
+    seq = eng.manager.seqs[5]
+    assert seq.done and seq.finish_reason == DEADLINE_EXPIRED and seq.blocks == []
+
+
+def test_preemption_disabled_leaves_decode_starved():
+    m, d, p_old, p_new = _starved_decode_setup()
+    sched = SplitFuseScheduler(token_budget=8, max_seqs_per_step=8,
+                               resilience=ServingResilienceConfig(preemption=False))
+    chunks = sched.schedule(m)
+    assert 1 not in {c.uid for c in chunks}
+    assert p_new.preemptions == 0 and len(p_new.blocks) == 3
+
+
+def test_engine_step_preempts_under_pressure():
+    """End-to-end through eng.step(): a decode that cannot grow preempts the
+    newest prefilling sequence and still emits its token."""
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    eng = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                            num_blocks=6, block_size=8, max_blocks_per_seq=8,
+                            token_budget=16, max_seqs_per_step=4)  # 5 usable blocks
+    eng.put([0], [[1] * 16])
+    out = eng.step()  # full prefill -> emits; seen=16, 2 blocks
+    assert 0 in out
+    eng.put([1], [[2] * 30])
+    b = eng.manager.seqs[1]
+    eng.manager.ensure_blocks(b, 24)  # simulate mid-prefill occupancy: 3 blocks, pool full
+    assert eng.manager.allocator.free_blocks == 0
+    out = eng.step()  # uid 0 needs its 3rd block at position 17 -> preemption
+    assert 0 in out
+    assert eng.scheduler.preempted_total >= 1 and b.preemptions >= 1
+    assert len(b.blocks) < 3
+    eng.flush(0)
+    eng.flush(1)
+    assert eng.manager.allocator.free_blocks == 5
+
+
+# ------------------------------------------------------------ fault injection
+def _tiny_engine(**kw):
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    defaults = dict(config={"dtype": "float32"}, num_blocks=32, block_size=8,
+                    max_blocks_per_seq=8, token_budget=32, max_seqs_per_step=4)
+    defaults.update(kw)
+    return InferenceEngineV2(llama, cfg, params, **defaults)
+
+
+def test_generate_survives_probabilistic_allocator_failure():
+    eng = _tiny_engine()
+    eng.manager.allocator = FaultyBlockedAllocator(32, fail_rate=0.4, seed=7)
+    initial_free = eng.manager.allocator.free_blocks
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17]]
+    results = eng.generate(prompts, max_new_tokens=6, strict=False)
+    assert all(r.status == OK for r in results)
+    assert eng.manager.allocator.injected_failures > 0, "faults never fired"
+    assert eng.manager.allocator.free_blocks == initial_free  # full reclamation
+    # and the tokens match a healthy engine's (faults only delay scheduling)
+    ref = _tiny_engine().generate(prompts, max_new_tokens=6)
+    assert [r.tokens for r in results] == ref
+
+
+def test_generate_survives_nth_call_allocation_failure():
+    eng = _tiny_engine()
+    eng.manager.allocator = FaultyBlockedAllocator(32, fail_every=2, seed=0)
+    initial_free = eng.manager.allocator.free_blocks
+    results = eng.generate([[1, 2, 3], [5, 6, 7, 8]], max_new_tokens=5, strict=False)
+    assert all(r.status == OK for r in results)
+    assert eng.manager.allocator.free_blocks == initial_free
+
+
+def test_frozen_sequence_strict_raises_with_snapshot():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_resilience": {"stall_watchdog_steps": 5}})
+    FrozenSequenceInjector(eng, 0).install()
+    with pytest.raises(ServingStalledError) as ei:
+        eng.generate([[1] * 40, [2, 3, 4]], max_new_tokens=4)
+    snap = ei.value.snapshot
+    assert 0 in snap["live_uids"]
+    assert snap["sequences"][0]["pending_tokens"] > 0
+    assert "free_blocks" in snap and "queue_depth" in snap
+    assert isinstance(snap["sequences"][0]["blocks"], list)
+
+
+def test_frozen_sequence_nonstrict_finishes_the_rest():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_resilience": {"stall_watchdog_steps": 5}})
+    initial_free = eng.manager.allocator.free_blocks
+    injector = FrozenSequenceInjector(eng, 0).install()
+    # frozen prompt (12) < token_budget (32): the healthy requests keep
+    # getting budget alongside the wedged re-prefills and finish first
+    results = eng.generate([[1] * 12, [2, 3, 4], [5, 6, 7, 8]],
+                           max_new_tokens=4, strict=False)
+    assert results[0].status == FAILED and "stalled" in results[0].reason
+    assert results[0].retryable
+    assert results[1].status == OK and results[2].status == OK
+    assert len(results[1].tokens) == 3 + 4
+    assert eng.manager.allocator.free_blocks == initial_free  # wedge reclaimed
+    assert eng.health()["live_seqs"] == 0
+    assert eng.health()["stalls_total"] == 1  # the trip is observable after the fact
+    # once the fault clears, the engine serves fresh batches again
+    injector.uninstall()
+    eng2_results = eng.generate([[9, 10, 11]], max_new_tokens=3, strict=False)
+    assert eng2_results[0].status == OK
+
+
+# ------------------------------------------------------------------ deadlines
+def test_deadline_expires_running_request():
+    # tick sized so expiry lands mid-decode even through the sliced burst path
+    # (deadlined requests still burst, in BURST_DEADLINE_SLICE chunks)
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock)
+    initial_free = eng.manager.allocator.free_blocks
+    results = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=64,
+                           strict=False, ttl_s=0.4)
+    r = results[0]
+    assert r.status == DEADLINE_EXPIRED and r.retryable
+    assert len(r.tokens) >= 5  # partial progress included
+    assert len(r.tokens) < 5 + 64
+    assert eng.manager.allocator.free_blocks == initial_free
+    assert eng.health()["deadline_expired_total"] == 1
+    # engine still serves a TTL-free batch fine afterwards
+    ok = eng.generate([[7, 8, 9]], max_new_tokens=3, strict=False)[0]
+    assert ok.status == OK
+
+
+def test_deadline_expires_queued_request():
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock,
+                       config={"dtype": "float32",
+                               "serving_resilience": {"max_live_seqs": 1}})
+    results = eng.generate([[1] * 12, [2, 3, 4]], max_new_tokens=48,
+                           strict=False, ttl_s=0.45)
+    statuses = {r.uid: r.status for r in results}
+    assert statuses[1] == DEADLINE_EXPIRED
+    assert results[1].tokens == []            # never admitted: no KV ever owned
+    assert "queue" in results[1].reason
+    assert eng.health()["deadline_expired_total"] >= 1
+
+
+def test_deadline_strict_raises():
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock)
+    with pytest.raises(RuntimeError, match="deadline_expired"):
+        eng.generate([[1, 2, 3]], max_new_tokens=64, ttl_s=0.3)
+    assert eng.health()["live_seqs"] == 0  # strict raise fully cleaned up
+
+
+# --------------------------------------------------------- shedding e2e / api
+def test_generate_sheds_over_queue_depth():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_resilience": {"max_queue_depth": 1,
+                                                      "max_live_seqs": 1}})
+    results = eng.generate([[1, 2, 3], [4, 5, 6], [7, 8, 9]],
+                           max_new_tokens=2, strict=False)
+    statuses = [r.status for r in results]
+    assert statuses[0] == OK
+    assert statuses.count(SHED) == 2
+    shed = [r for r in results if r.status == SHED]
+    assert all(r.retryable and "queue_full" in r.reason for r in shed)
+    assert eng.health()["shed_total"] == 2
+
+
+def test_generate_sheds_empty_prompt():
+    eng = _tiny_engine()
+    results = eng.generate([[1, 2, 3], []], max_new_tokens=2, strict=False)
+    assert results[0].status == OK
+    assert results[1].status == SHED and not results[1].retryable
+    assert "empty_prompt" in results[1].reason
+    with pytest.raises(RuntimeError, match="empty_prompt"):
+        eng.generate([[]], max_new_tokens=2)
+    # strict raise left no residue
+    assert eng.generate([[5, 6]], max_new_tokens=2) is not None
+
+
+def test_generate_sheds_over_cap_prompt_before_allocation():
+    eng = _tiny_engine()  # cap = 8 blocks * 8 = 64 positions
+    initial_free = eng.manager.allocator.free_blocks
+    results = eng.generate([list(range(1, 70))], max_new_tokens=2, strict=False)
+    assert results[0].status == SHED and not results[0].retryable
+    assert "prompt_over_cap" in results[0].reason
+    assert eng.manager.allocator.free_blocks == initial_free  # shed pre-allocation
+
+
+def test_request_result_shape():
+    eng = _tiny_engine()
+    r = eng.generate([[1, 2, 3]], max_new_tokens=2, strict=False)[0]
+    assert isinstance(r, RequestResult) and r.ok
+    assert r.uid == 0 and r.finish_reason == "max_new_tokens"
+    assert r.preemptions == 0 and r.queue_wait_s >= 0.0
+    # strict mode returns the same tokens, bare
+    assert eng.generate([[1, 2, 3]], max_new_tokens=2) == [r.tokens]
+
+
+# ------------------------------------------------------- health & telemetry
+def test_engine_health_snapshot():
+    eng = _tiny_engine()
+    h = eng.health()
+    assert h["live_seqs"] == 0 and h["queue_depth"] == 0 and h["stalls_total"] == 0
+    assert h["free_blocks"] == 31  # 32 - trash
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    h = eng.health()
+    assert h["completed_total"] == 1 and h["scheduler_steps"] > 0
+    assert h["shed_total"] == 0 and h["preempted_total"] == 0
+    assert h["stalls_total"] == 0
+
+
+def test_resilience_events_reach_telemetry_jsonl(tmp_path):
+    from deepspeed_tpu.monitor.telemetry import TelemetryCollector
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    jsonl = str(tmp_path / "serving.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    clock = FakeClock(tick=0.05)  # expiry must land before the sliced bursts finish
+    eng = _tiny_engine(telemetry=collector, clock=clock,
+                       config={"dtype": "float32",
+                               "serving_resilience": {"max_queue_depth": 1,
+                                                      "max_live_seqs": 1,
+                                                      "stall_watchdog_steps": 5}})
+    # sheds (queue depth) + a deadline expiry in one run
+    eng.generate([[1] * 12, [2, 3, 4], [5, 6, 7]], max_new_tokens=48,
+                 strict=False, ttl_s=0.4)
+    collector.close()
+    with open(jsonl) as fh:
+        records = [json.loads(line) for line in fh]
+    events = {r["event"] for r in records if r["kind"] == "resilience"}
+    assert "serving_shed" in events
+    assert "serving_deadline_expired" in events
+    gauges = [r for r in records if r["kind"] == "gauges" and "shed_total" in r]
+    assert gauges and gauges[-1]["shed_total"] >= 1.0
+
+
+def test_mixed_faults_full_reclamation():
+    """The acceptance scenario in one: probabilistic allocator faults + a
+    frozen sequence + tight admission — per-request statuses come back, the
+    watchdog fires instead of looping, and every KV block is reclaimed."""
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_resilience": {"stall_watchdog_steps": 6,
+                                                      "max_live_seqs": 3}})
+    eng.manager.allocator = FaultyBlockedAllocator(32, fail_rate=0.2, seed=3)
+    initial_free = eng.manager.allocator.free_blocks
+    FrozenSequenceInjector(eng, 1).install()
+    prompts = [[1, 2, 3], [4] * 24, [5, 6, 7, 8], [9, 10], [11] * 10]
+    results = eng.generate(prompts, max_new_tokens=4, strict=False)
+    assert len(results) == 5
+    by_status = {r.uid: r.status for r in results}
+    assert by_status[1] == FAILED                      # the frozen one
+    assert all(by_status[u] == OK for u in (0, 2, 3, 4))
+    assert eng.manager.allocator.free_blocks == initial_free
+    assert eng.health()["live_seqs"] == 0 and eng.health()["queue_depth"] == 0

@@ -42,9 +42,10 @@ def test_serving_scenario_stall_guard():
         def flush(self, uid):
             pass
 
-    tokens, dt, lats = bench._run_serving_scenario(
+    tokens, dt, lats, hit_stall = bench._run_serving_scenario(
         StuckEngine(), [[1, 2]], {0: [0]}, max_new=4)
     assert tokens == 0 and lats == []  # bailed via the stall counter
+    assert hit_stall  # and the bail is reported, not silent (ISSUE 4 review)
 
 
 def test_infinity_shape_ladder_budget_math():
