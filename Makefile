@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke lint lint-baseline
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -45,3 +45,10 @@ resilience-smoke:
 # zero stalls and the KV pool fully reclaimed; also a lane in run_tests.py
 serving-resilience-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --serving-resilience-smoke
+
+# serving fast path invariants on CPU (counters, not wall-clock): <=1 host
+# sync per steady-state serve-loop iteration, fused decode dominates, zero
+# recompiles on a warm identical rerun, byte-identical to the
+# serving_fastpath.enabled=False reference loop; also a lane in run_tests.py
+serving-fastpath-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --serving-fastpath-smoke

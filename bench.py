@@ -683,8 +683,12 @@ def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
     """Drive the v2 engine through a continuous-batching scenario: requests
     arrive (``arrivals``: {step_idx: [uids]}) WHILE earlier ones decode, so
     SplitFuse actually mixes prefill chunks and decode singles in one ragged
-    batch.  Returns (total_new_tokens, elapsed_s, per-step latencies of
-    token-emitting steps, hit_stall_bail)."""
+    batch.  Steers the engine the way its own serve loop does (ISSUE 5):
+    once the live set is decode-only, up to ``k`` steps fuse into ONE
+    compiled burst — capped so arrivals still land on their scheduled step
+    index — and mixed steps run through the device-resident step() path.
+    Returns (total_new_tokens, elapsed_s, per-decode-step latencies (a burst
+    of k contributes k samples of dt/k), hit_stall_bail, host-link deltas)."""
     produced = {u: 0 for u in range(len(prompts))}
     done = set()
     pending = dict(arrivals)
@@ -692,17 +696,45 @@ def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
     tokens = 0
     step_i = 0
     stalled = 0
+    link0 = eng.counters.snapshot()
     t_start = time.perf_counter()
     while len(done) < len(prompts):
         if step_i in pending:
             uids = pending.pop(step_i)
             eng.put(uids, [prompts[u] for u in uids])
+
+        def _retire(uid, n_new):
+            nonlocal tokens
+            tokens += n_new
+            produced[uid] += n_new
+            if produced[uid] >= max_new:
+                eng.manager.seqs[uid].done = True
+                done.add(uid)
+                eng.flush(uid)
+
+        # adaptive decode fusion between arrival boundaries
+        live = [u for u, s in eng.manager.seqs.items() if not s.done]
+        k = min((max_new - produced[u] for u in live), default=0)
+        next_arrival = min(pending, default=None)
+        if next_arrival is not None:
+            k = min(k, next_arrival - step_i)
+        if k >= 2:
+            t0 = time.perf_counter()
+            burst = eng.decode_burst(k)
+            dt = time.perf_counter() - t0
+            if burst is not None:
+                lats.extend([dt / k] * k)
+                stalled = 0
+                for uid, toks in burst.items():
+                    _retire(uid, len(toks))
+                step_i += k
+                continue
+
         t0 = time.perf_counter()
         out = eng.step()  # host-synchronous: tokens are materialized ints
         dt = time.perf_counter() - t0
         if out:
             lats.append(dt)
-            tokens += len(out)
             stalled = 0
         elif not pending and not any(s.pending_tokens > 0 and not s.done
                                      for s in eng.manager.seqs.values()):
@@ -715,13 +747,10 @@ def _run_serving_scenario(eng, prompts, arrivals, max_new: int):
             if stalled > 100:
                 break
         for uid in out:
-            produced[uid] += 1
-            if produced[uid] >= max_new:
-                eng.manager.seqs[uid].done = True
-                done.add(uid)
-                eng.flush(uid)
+            _retire(uid, 1)
         step_i += 1
-    return tokens, time.perf_counter() - t_start, lats, stalled > 100
+    link = eng.counters.delta_since(link0)
+    return tokens, time.perf_counter() - t_start, lats, stalled > 100, link
 
 
 def measure_serving_mixed(on_tpu: bool):
@@ -760,7 +789,7 @@ def measure_serving_mixed(on_tpu: bool):
                 n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
                 n_req // 4 + 12: list(range(3 * n_req // 4, n_req))}
     _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
-    tokens, dt, lats, hit_stall = _run_serving_scenario(eng, prompts, arrivals, max_new)
+    tokens, dt, lats, hit_stall, link = _run_serving_scenario(eng, prompts, arrivals, max_new)
     if not lats:
         return {"serving_mixed": "no tokens emitted"}
     return {"serving_mixed_tok_s": round(tokens / dt, 1),
@@ -771,7 +800,12 @@ def measure_serving_mixed(on_tpu: bool):
             # resilience counters (ISSUE 4): a clean run preempts rarely and
             # never trips the scenario's own stall bail
             "serving_mixed_preempted": int(eng.health()["preempted_total"]),
-            "serving_mixed_stalled": bool(hit_stall)}
+            "serving_mixed_stalled": bool(hit_stall),
+            # host-link counters (ISSUE 5): the serve loop's orchestration
+            # cost — device->host syncs per emitted token and the fraction of
+            # tokens produced inside fused decode bursts
+            "serving_mixed_host_syncs_per_tok": round(link["host_syncs"] / max(tokens, 1), 4),
+            "serving_mixed_burst_fraction": round(link["burst_tokens"] / max(tokens, 1), 3)}
 
 
 def measure_fsdp_virtual(timeout_s: int = 280):

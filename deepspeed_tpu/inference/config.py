@@ -6,7 +6,7 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
-from ..runtime.config import ServingResilienceConfig
+from ..runtime.config import ServingFastpathConfig, ServingResilienceConfig
 from ..runtime.config_utils import ConfigModel, Field
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
@@ -43,6 +43,9 @@ class InferenceConfig(ConfigModel):
     # v2 ragged engine (runtime/config.py defines the section so train+serve
     # configs share one spelling)
     serving_resilience: ServingResilienceConfig = Field(ServingResilienceConfig)
+    # serving hot-path policy (device-resident batch buffers, async step
+    # pipelining, adaptive decode fusion) — inference/v2/fastpath.py
+    serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

@@ -4,6 +4,7 @@ from .admission import (AdmissionQueue, RequestResult, ServingStalledError, Shed
 from .blocked_allocator import BlockedAllocator, KVAllocationError
 from .engine_factory import build_engine, build_hf_engine
 from .engine_v2 import InferenceEngineV2
+from .fastpath import PENDING_TOKEN, DeferredTokens, DeviceBatchState, ServeCounters
 from .ragged_manager import (EmptyPromptError, RaggedStateManager, SequenceDescriptor,
                              UnknownSequenceError)
 from .scheduler import ScheduledChunk, SplitFuseScheduler

@@ -25,6 +25,8 @@ from ..config import DTYPES as _DTYPES, load_inference_config
 from .admission import (DEADLINE_EXPIRED, FAILED, OK, PREEMPT_REQUEUED_EXHAUSTED, SHED,
                         AdmissionQueue, RequestResult, ServingStalledError)
 from .blocked_allocator import KVAllocationError
+from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchState,
+                       ServeCounters, materialize, round_up_pow2)
 from .ragged_manager import RaggedStateManager
 from .scheduler import SplitFuseScheduler
 
@@ -54,11 +56,19 @@ def candidate_sample(row, rng, *, temperature, top_k, top_p, axis):
 
 class InferenceEngineV2:
 
-    # decode-burst length while any live request carries a deadline: the
-    # deadline is only enforceable between host round-trips, so this bounds
-    # eviction overshoot (tokens decoded past expiry) while keeping ~SLICE x
-    # fewer round-trips than stepwise decode
+    # decode-burst length while any live request carries a deadline OR the
+    # admission queue is non-empty: the deadline is only enforceable between
+    # host round-trips, so this bounds eviction overshoot (tokens decoded past
+    # expiry) and admission latency while keeping ~SLICE x fewer round-trips
+    # than stepwise decode
     BURST_DEADLINE_SLICE = 8
+    # table-width bucketing (serving fastpath satellite): widths grow in
+    # block-table-slot steps of TABLE_STEP with sticky hysteresis — a shrink
+    # only happens after TABLE_SHRINK_PATIENCE consecutive steps of slack, so
+    # one long sequence entering/leaving the batch doesn't force a recompile
+    # cascade across every (n, t) bucket it touches mid-serve
+    TABLE_STEP = 4
+    TABLE_SHRINK_PATIENCE = 16
 
     def __init__(self, model_module, model_config, params, config: Optional[Dict] = None,
                  num_blocks: int = 512, block_size: int = 16,
@@ -114,8 +124,18 @@ class InferenceEngineV2:
         self._fwd_cache: Dict = {}
         self._rng = jax.random.PRNGKey(self.config.seed)
         self.max_blocks_per_seq = max_blocks_per_seq
+        # serving fast path (ISSUE 5): persistent device-resident batch
+        # buffers, deferred pick syncs, and host-link counters that make the
+        # orchestration cost observable (fastpath.py)
+        self.fastpath = self.config.serving_fastpath
+        self.counters = ServeCounters()
+        self.batch_state = DeviceBatchState(self.counters)
+        self._inflight: Optional[DeferredTokens] = None
+        self._table_width = 0
+        self._table_slack = 0
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{block_size} "
-                 f"budget={token_budget} dtype={self.config.dtype} tp={self.tp}", ranks=[0])
+                 f"budget={token_budget} dtype={self.config.dtype} tp={self.tp} "
+                 f"fastpath={'on' if self.fastpath.enabled else 'off'}", ranks=[0])
 
     def _warn_truncated_nucleus(self):
         """One-time runtime notice when TP candidate-set sampling approximates
@@ -156,6 +176,7 @@ class InferenceEngineV2:
         the next ragged batch is scheduled."""
         ttl = ttl_s if ttl_s is not None else self.resilience.default_ttl_s
         deadline = self._clock() + ttl if ttl is not None else None
+        self._reset_table_width_if_idle()
         for uid, prompt in zip(uids, prompts):
             self.manager.add_sequence(int(uid), [int(t) for t in prompt],
                                       deadline=deadline)
@@ -163,45 +184,194 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         self.manager.retire(uid)
 
+    def _reset_table_width_if_idle(self) -> None:
+        """Fresh serve (no tracked sequences): drop the sticky table width so
+        a repeated scenario replays the same width trajectory — and therefore
+        hits the same compiled programs — as its first run."""
+        if not self.manager.seqs:
+            self._table_width = 0
+            self._table_slack = 0
+
     # ------------------------------------------------------------------- step
+    def _build_fwd_jit(self):
+        model, cfg, bs = self.model, self.model_config, self.block_size
+        if self.tp > 1:
+            def fwd(params, kv, tokens, n_tokens, start_pos, tables):
+                return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
+                                           tables, kv, block_size=bs,
+                                           tp_axis=TENSOR_AXIS)
+            fwd = self._shard_mapped(fwd, (PartitionSpec(), self._kv_specs))
+        else:
+            def fwd(params, kv, tokens, n_tokens, start_pos, tables):
+                return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
+                                           tables, kv, block_size=bs)
+        return jax.jit(fwd, donate_argnums=(1, ))  # dslint: disable=donation-after-use  # call-site contract: step() reassigns self.kv from the result in the same statement (the KV pool is donated so decode updates alias in place)
+
     def _compiled_fwd(self, n: int, t: int, b: int):
         key = (n, t, b)
         if key not in self._fwd_cache:
-            model, cfg, bs = self.model, self.model_config, self.block_size
-            if self.tp > 1:
-                def fwd(params, kv, tokens, n_tokens, start_pos, tables):
-                    return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
-                                               tables, kv, block_size=bs,
-                                               tp_axis=TENSOR_AXIS)
-                fwd = self._shard_mapped(fwd, (PartitionSpec(), self._kv_specs))
-            else:
-                def fwd(params, kv, tokens, n_tokens, start_pos, tables):
-                    return model.forward_paged(cfg, params, tokens, n_tokens, start_pos,
-                                               tables, kv, block_size=bs)
-
-            self._fwd_cache[key] = jax.jit(fwd, donate_argnums=(1, ))  # dslint: disable=donation-after-use  # call-site contract: step() reassigns self.kv from the result in the same statement (the KV pool is donated so decode updates alias in place)
+            self._fwd_cache[key] = self._build_fwd_jit()
+            self.counters.compiles += 1
         return self._fwd_cache[key]
 
-    @staticmethod
-    def _bucket(n: int) -> int:
-        b = 1
-        while b < n:
-            b *= 2
-        return b
+    def _aot_compile_fwd(self, n: int, t: int, b: int) -> None:
+        """Prewarm one (n_seqs, chunk, table_width) bucket ahead of the serve
+        loop: lower + compile the ragged forward against abstract shapes and
+        cache the executable, so the first mid-wave step that lands in the
+        bucket dispatches instead of stalling p95 on a compile."""
+        key = (n, t, b)
+        if key in self._fwd_cache:
+            return
+        ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+        abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        compiled = self._build_fwd_jit().lower(
+            jax.tree_util.tree_map(abstract, self.params),
+            jax.tree_util.tree_map(abstract, self.kv),
+            ints((n, t)), ints((n, )), ints((n, )), ints((n, b))).compile()
+        self._fwd_cache[key] = compiled
+        self.counters.compiles += 1
+
+    # batch-shape bucketing shares the ONE pow2 primitive with the scatter-row
+    # padding in fastpath.DeviceBatchState (divergence would multiply shapes)
+    _bucket = staticmethod(round_up_pow2)
+
+    def _stepped_width(self, blocks: int) -> int:
+        """Block-table width rounded up in TABLE_STEP-slot increments, capped
+        at max_blocks_per_seq — shared by the live bucketing (hysteresis) and
+        the prewarm's bucket prediction so the two can't drift apart."""
+        return min(-(-blocks // self.TABLE_STEP) * self.TABLE_STEP,
+                   self.max_blocks_per_seq)
+
+    def _table_width_for(self, need: int) -> int:
+        """Bucketed block-table width for this step's batch.
+
+        Fast path: round ``need`` up in TABLE_STEP-slot increments with sticky
+        hysteresis — the width never shrinks until TABLE_SHRINK_PATIENCE
+        consecutive steps had at least a full step of slack.  The paged
+        kernel's grid walks every table slot, so stepped widths waste at most
+        TABLE_STEP-1 dead slots (pure doubling wastes up to 2x), and the
+        stickiness keeps one long sequence joining/leaving the batch from
+        recompiling every (n, t) bucket it touches.  Reference mode
+        (``serving_fastpath.enabled=False``) keeps the original pure-doubling
+        behavior as the equivalence oracle."""
+        need = min(need, self.max_blocks_per_seq)
+        if not self.fastpath.enabled:
+            return min(self._bucket(need), self.max_blocks_per_seq)
+        stepped = self._stepped_width(need)
+        w = self._table_width
+        if stepped > w:
+            w = stepped
+            self._table_slack = 0
+        elif stepped <= w - self.TABLE_STEP:
+            self._table_slack += 1
+            if self._table_slack >= self.TABLE_SHRINK_PATIENCE:
+                w = stepped
+                self._table_slack = 0
+        else:
+            self._table_slack = 0
+        self._table_width = w
+        return w
 
     def step(self, greedy: bool = True) -> Dict[int, int]:
         """Run one SplitFuse step; returns {uid: sampled_token} for sequences
-        that produced a next token (finished prefill or decoded)."""
+        that produced a next token (finished prefill or decoded).
+
+        With the serving fast path enabled this is dispatch + immediate
+        materialize over the persistent device batch buffers; the serve loop
+        uses the split halves directly to defer the materialize by one step.
+        TP-sharded serving stays on the reference path: DeviceBatchState's
+        scatter commits its buffers to a single device, which a shard_mapped
+        forward over a real multi-device mesh would reject."""
+        if not self.fastpath.enabled or self.tp > 1:
+            return self._step_reference(greedy)
+        deferred = self._dispatch_step(greedy)
+        if deferred is None:
+            return {}
+        return deferred.patch(self.manager)
+
+    def _dispatch_step(self, greedy: bool) -> Optional[DeferredTokens]:
+        """Fast-path step dispatch: incrementally scatter this step's deltas
+        into the bucket's persistent device buffers, launch forward + pick,
+        and return a :class:`DeferredTokens` handle WITHOUT waiting on the
+        sampled tokens.  Emitting sequences get a PENDING_TOKEN placeholder
+        (count-accurate for scheduling) that ``patch()`` later overwrites; a
+        decode row whose input token is still in flight is fed on-device from
+        the previous step's sampled tokens and never visits the host."""
         self._expire_live()  # TTL enforcement between forwards, never mid-batch
+        chunks = self.scheduler.schedule(self.manager)
+        if not chunks:
+            return None
+        n = self._bucket(len(chunks))
+        t = self._bucket(max(c.n_tokens for c in chunks))
+        # bucket the table width to the live maximum: the paged kernel's grid
+        # walks every table slot, so dead trailing slots are pure waste
+        b = self._table_width_for(max(len(self.manager.seqs[c.uid].blocks)
+                                      for c in chunks))
+        key = (n, t, b)
+        rows = []
+        feeds = []
+        tokens_run = 0
+        for i, c in enumerate(chunks):
+            seq = self.manager.seqs[c.uid]
+            sl = seq.tokens[seq.seen_tokens:seq.seen_tokens + c.n_tokens]
+            packed = np.zeros(3 + t + b, np.int32)
+            packed[0] = i
+            if c.n_tokens == 1 and sl[0] == PENDING_TOKEN:
+                # the input token is the previous step's sample, still on
+                # device: feed it device-side instead of waiting for it
+                if self._inflight is None or c.uid not in self._inflight.row_of:
+                    raise RuntimeError(f"uid {c.uid}: pending token scheduled with no "
+                                       f"in-flight step to feed it from")
+                feeds.append((i, self._inflight.row_of[c.uid]))
+                packed[1] = FED_SENTINEL
+            else:
+                packed[1:1 + len(sl)] = sl
+            packed[1 + t] = c.n_tokens
+            packed[2 + t] = seq.seen_tokens
+            packed[3 + t:] = self.manager.block_table_row(seq, width=b)
+            rows.append((i, packed))
+            tokens_run += c.n_tokens
+        slot = self.batch_state.update(key, rows, n_active=len(chunks),
+                                       trash_block=self.manager.trash_block)
+        if feeds:
+            self.batch_state.feed(key, self._inflight.toks_dev, feeds)
+        fwd = self._compiled_fwd(n, t, b)
+        self.counters.dispatches += 1
+        logits, self.kv = fwd(self.params, self.kv, slot.tokens, slot.n_tokens,
+                              slot.start_pos, slot.tables)
+        # token selection runs ON DEVICE (argmax or temperature/top-k/top-p
+        # sampling) — only n ints cross the host link, not [n, V] logits
+        # (reference: ragged sampling stays device-side, engine_v2.py:107)
+        pick = self._compiled_step_pick(n, greedy)
+        self.counters.dispatches += 1
+        toks_dev, self._rng = pick(logits, slot.n_tokens, self._rng)
+        emits = []
+        row_of: Dict[int, int] = {}
+        for i, c in enumerate(chunks):
+            seq = self.manager.seqs[c.uid]
+            seq.seen_tokens += c.n_tokens
+            if seq.seen_tokens >= len(seq.tokens):
+                # produced a next token (end of prompt, or a decode step)
+                seq.tokens.append(PENDING_TOKEN)
+                emits.append((c.uid, len(seq.tokens) - 1, i))
+                row_of[c.uid] = i
+        self.counters.step_tokens += len(emits)
+        self._emit_serving_gauges(tokens_run=tokens_run)
+        return DeferredTokens(toks_dev=toks_dev, emits=emits, row_of=row_of,
+                              counters=self.counters)
+
+    def _step_reference(self, greedy: bool) -> Dict[int, int]:
+        """The pre-fastpath step: full host-side batch rebuild + four uploads
+        + synchronous fetch per step.  Kept verbatim as the equivalence oracle
+        (``serving_fastpath.enabled=False``) the fastpath tests diff against."""
+        self._expire_live()
         chunks = self.scheduler.schedule(self.manager)
         if not chunks:
             return {}
         n = self._bucket(len(chunks))
         t = self._bucket(max(c.n_tokens for c in chunks))
-        # bucket the table width to the live maximum: the paged kernel's grid
-        # walks every table slot, so dead trailing slots are pure waste
-        b = self._bucket(max(len(self.manager.seqs[c.uid].blocks) for c in chunks))
-        b = min(b, self.max_blocks_per_seq)
+        b = self._table_width_for(max(len(self.manager.seqs[c.uid].blocks)
+                                      for c in chunks))
         tokens = np.zeros((n, t), np.int32)
         n_tokens = np.zeros((n, ), np.int32)
         start_pos = np.zeros((n, ), np.int32)
@@ -212,27 +382,30 @@ class InferenceEngineV2:
             tokens[i, :len(sl)] = sl
             n_tokens[i] = c.n_tokens
             start_pos[i] = seq.seen_tokens
-            tables[i] = self.manager.block_table_row(seq)[:b]
+            tables[i] = self.manager.block_table_row(seq, width=b)
 
         fwd = self._compiled_fwd(n, t, b)
+        self.counters.dispatches += 2
+        # five uploads: four batch arrays into the forward + n_tokens again
+        # into the pick (the fast path derives the pick's input on device)
+        self.counters.uploads += 5
+        self.counters.upload_ints += int(tokens.size + 2 * n_tokens.size
+                                         + start_pos.size + tables.size)
         logits, self.kv = fwd(self.params, self.kv, jnp.asarray(tokens), jnp.asarray(n_tokens),
                               jnp.asarray(start_pos), jnp.asarray(tables))
-        # token selection runs ON DEVICE (argmax or temperature/top-k/top-p
-        # sampling) — only n ints cross the host link, not [n, V] logits
-        # (reference: ragged sampling stays device-side, engine_v2.py:107)
         pick = self._compiled_step_pick(n, greedy)
-        toks_dev, self._rng = pick(logits, jnp.asarray(np.maximum(n_tokens - 1, 0)), self._rng)
-        toks = np.asarray(toks_dev)  # dslint: disable=host-sync-in-hot-path  # by design: only n sampled ints cross the host link per step (never the [n, V] logits)
+        toks_dev, self._rng = pick(logits, jnp.asarray(n_tokens), self._rng)
+        toks = materialize(toks_dev, self.counters)  # one sync: n sampled ints
 
         out: Dict[int, int] = {}
         for i, c in enumerate(chunks):
             seq = self.manager.seqs[c.uid]
             seq.seen_tokens += c.n_tokens
             if seq.seen_tokens >= len(seq.tokens):
-                # produced a next token (end of prompt, or a decode step)
                 tok = int(toks[i])
                 seq.tokens.append(tok)
                 out[c.uid] = tok
+        self.counters.step_tokens += len(out)
         self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
 
@@ -241,6 +414,7 @@ class InferenceEngineV2:
         (retired-sequence rate) and tokens/s through the ragged forward."""
         if self.telemetry is None:
             return
+        c = self.counters
         gauges = {"live_seqs": float(len(self.manager.live_uids())),
                   # resilience gauges (ISSUE 4): shed/preempt/deadline lifetime
                   # counters + last admission wait, next to the serving rates
@@ -248,7 +422,16 @@ class InferenceEngineV2:
                   "shed_total": float(self.admission.shed_total),
                   "preempted_total": float(self.scheduler.preempted_total),
                   "deadline_expired_total": float(self._deadline_expired_total),
-                  "queue_wait": float(self._queue_wait_s)}
+                  "queue_wait": float(self._queue_wait_s),
+                  # fastpath gauges (ISSUE 5): the host-link cost of serving —
+                  # device->host syncs, program dispatches, compiled buckets,
+                  # ints uploaded, and the fraction of tokens emitted fused
+                  "fastpath_host_syncs": float(c.host_syncs),
+                  "fastpath_dispatches": float(c.dispatches),
+                  "fastpath_compiled_programs": float(c.compiles),
+                  "fastpath_upload_ints": float(c.upload_ints),
+                  "fastpath_burst_fraction":
+                      c.burst_tokens / max(c.burst_tokens + c.step_tokens, 1)}
         rps = self.telemetry.rate("v2_completed_requests",
                                   float(self.manager.completed_requests))
         if rps is not None:
@@ -268,13 +451,17 @@ class InferenceEngineV2:
             temperature, top_k, top_p = (self.config.temperature, self.config.top_k,
                                          self.config.top_p)
 
-            def pick(logits, last, rng):
+            def pick(logits, n_tokens, rng):
+                # last valid position per row, derived on device so the host
+                # uploads nothing pick-specific
+                last = jnp.maximum(n_tokens - 1, 0)
                 row = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0]
                 if greedy:
                     return jnp.argmax(row, axis=-1).astype(jnp.int32), rng
                 return _sample(row, rng, temperature=temperature, top_k=top_k, top_p=top_p)
 
             self._fwd_cache[key] = jax.jit(pick)
+            self.counters.compiles += 1
         return self._fwd_cache[key]
 
     # ------------------------------------------------------------ decode burst
@@ -337,6 +524,10 @@ class InferenceEngineV2:
                     logits, kv = model.forward_paged(cfg, params, tok[:, None], ones,
                                                      start, tables, kv, block_size=bs,
                                                      **tp_kw)
+                    # one split key per fused step: the rng carried through the
+                    # scan is the ENGINE rng, advanced by _sample exactly as the
+                    # stepwise pick advances it — burst and per-step decode
+                    # sample identical tokens for the same seed
                     nxt, rng = pick(logits[:, 0], rng)
                     # finished rows freeze: re-emit the last token (the pool
                     # keeps absorbing writes into pre-allocated slots; the host
@@ -345,14 +536,18 @@ class InferenceEngineV2:
                     done = jnp.logical_or(done, nxt == jnp.int32(eos))
                     return (kv, nxt, start + 1, rng, done), (nxt, done)
 
-                (kv, _, _, _, _), (toks, dones) = jax.lax.scan(
+                (kv, _, _, rng, _), (toks, dones) = jax.lax.scan(
                     body, (kv, tok0, start0, rng0, done0), None, length=k)
-                return kv, toks, dones  # [K, N] each
+                # toks/dones ride ONE fetch: pack [K, N] tokens over [K, N]
+                # done flags into a single [2K, N] int32 array
+                packed = jnp.concatenate([toks, dones.astype(jnp.int32)], axis=0)
+                return kv, packed, rng
 
             if self.tp > 1:
                 burst = self._shard_mapped(
                     burst, (self._kv_specs, PartitionSpec(), PartitionSpec()))
             self._fwd_cache[key] = jax.jit(burst, donate_argnums=(1, ))  # dslint: disable=donation-after-use  # call-site contract: decode_burst() reassigns self.kv from the result in the same statement
+            self.counters.compiles += 1
         return self._fwd_cache[key]
 
     def decode_burst(self, k: int, greedy: bool = True,
@@ -370,12 +565,16 @@ class InferenceEngineV2:
         more slots per sequence; returns None when not applicable (caller
         falls back to step()).
         """
-        live = [s for s in self.manager.seqs.values()
-                if not s.done and s.pending_tokens > 0]
-        if not live or any(s.pending_tokens != 1 for s in live):
-            return None
+        live, prefilling = self.scheduler.live_split(self.manager)
+        if not live or prefilling:
+            return None  # fuse only a pure-decode live set
         if len(live) > self.scheduler.max_seqs:
             return None
+        if self._inflight is not None:
+            # a deferred pick is still in flight: its placeholder would be
+            # this burst's input token — patch it in first (idempotent; the
+            # serve loop still absorbs the same handle afterwards)
+            self._inflight.patch(self.manager)
         max_pos = getattr(self.model_config, "max_seq_len", None)
         total_new = 0
         for seq in live:
@@ -408,25 +607,31 @@ class InferenceEngineV2:
             return None
 
         n = self._bucket(len(live))
-        b = min(self._bucket(max(len(s.blocks) for s in live)), self.max_blocks_per_seq)
+        b = self._table_width_for(max(len(s.blocks) for s in live))
         tok0 = np.zeros((n, ), np.int32)
         start0 = np.zeros((n, ), np.int32)
         tables = np.full((n, b), self.manager.trash_block, np.int32)
         for i, seq in enumerate(live):
             tok0[i] = seq.tokens[seq.seen_tokens]
             start0[i] = seq.seen_tokens
-            tables[i] = self.manager.block_table_row(seq)[:b]
+            tables[i] = self.manager.block_table_row(seq, width=b)
         # padded rows: decode into the trash block at position 0
         sample_cfg = None if greedy else (self.config.temperature, self.config.top_k,
                                           self.config.top_p)
         eos = -1 if eos_token_id is None else int(eos_token_id)
         burst = self._compiled_burst(n, k, sample_cfg=sample_cfg, eos=eos)
-        self._rng, sub = jax.random.split(self._rng)
         done0 = jnp.zeros((n, ), jnp.bool_)
-        self.kv, toks, dones = burst(self.params, self.kv, jnp.asarray(tok0),
-                                     jnp.asarray(start0), jnp.asarray(tables), sub, done0)
-        toks = np.asarray(toks)    # [K, N]  # dslint: disable=host-sync-in-hot-path  # by design: the burst's whole point — ONE host round-trip of k*n ints per k decode steps
-        dones = np.asarray(dones)  # [K, N]  # dslint: disable=host-sync-in-hot-path  # rides the same single burst fetch as toks
+        self.counters.dispatches += 1
+        self.counters.uploads += 3
+        self.counters.upload_ints += int(tok0.size + start0.size + tables.size)
+        # the scan carries the ENGINE rng itself (no pre-split): each fused
+        # step consumes exactly the key the stepwise pick would, so burst and
+        # per-step decode are sample-for-sample identical
+        self.kv, packed, self._rng = burst(self.params, self.kv, jnp.asarray(tok0),
+                                           jnp.asarray(start0), jnp.asarray(tables),
+                                           self._rng, done0)
+        fetched = materialize(packed, self.counters)  # ONE sync per k steps
+        toks, dones = fetched[:k], fetched[k:]        # [K, N] each
         out: Dict[int, List[int]] = {}
         for i, seq in enumerate(live):
             col = toks[:, i]
@@ -436,6 +641,7 @@ class InferenceEngineV2:
             produced = [int(t) for t in col[:n_real]]
             seq.tokens.extend(produced)
             seq.seen_tokens += n_real
+            self.counters.burst_tokens += n_real
             out[seq.uid] = produced
         return out
 
@@ -477,6 +683,7 @@ class InferenceEngineV2:
                strict: bool, priorities: Optional[Sequence[int]],
                ttl_s: Optional[float]) -> Dict[int, RequestResult]:
         my = set(uids)
+        self._reset_table_width_if_idle()
         conflict = sorted(my & set(self.manager.seqs))
         if conflict:
             # fail fast BEFORE any queue/manager mutation: finalization and
@@ -507,6 +714,7 @@ class InferenceEngineV2:
                         raise RuntimeError(f"request {uid} shed: {shed}")
                     results[uid] = RequestResult(uid=uid, status=SHED, reason=str(shed),
                                                  retryable=shed.retryable)
+            self._prewarm(max_new_tokens)
             self._serve_loop(uids, my, results, produced, max_new_tokens=max_new_tokens,
                              eos_token_id=eos_token_id, greedy=greedy, strict=strict)
         except Exception:
@@ -521,9 +729,30 @@ class InferenceEngineV2:
                     produced: Dict[int, int], *, max_new_tokens: int,
                     eos_token_id: Optional[int], greedy: bool, strict: bool) -> None:
         cfg = self.resilience
+        fp = self.fastpath
+        fusion_min = max(2, fp.fusion_min_steps) if fp.enabled else 2
+        # an externally wrapped step() (fault injectors, tracing shims) must
+        # keep intercepting every step, so the split dispatch/materialize
+        # pipeline only engages on an unwrapped engine
+        can_pipeline = (fp.enabled and fp.pipeline_depth > 0 and self.tp == 1
+                        and "step" not in self.__dict__)
         stall_streak = 0
         last_sig = None
+
+        def absorb(stepped):
+            self._absorb_step(stepped, my, results, produced,
+                              max_new_tokens=max_new_tokens,
+                              eos_token_id=eos_token_id, strict=strict)
+
         while any(u not in results for u in uids):
+            self.counters.loop_iterations += 1
+            if self._inflight is not None and (len(self.admission)
+                                               or self._any_live_deadline()):
+                # wave boundary: admission/deadline handling below may evict
+                # or finalize sequences — catch host state up to the device
+                # first so PR-4 semantics match the synchronous loop exactly
+                self.counters.flushes += 1
+                absorb(self._settle_inflight())
             self._expire_live()
             self._pump_admissions(my, results, strict)
 
@@ -534,15 +763,22 @@ class InferenceEngineV2:
             # is SLICED so admission latency (and deadline-eviction
             # overshoot) stays bounded to a few tokens instead of paying the
             # per-token host round-trip for a whole backpressure window.
-            live = [u for u in uids if u not in results]
-            k = min((max_new_tokens - produced[u] for u in live), default=0)
-            # ALL live sequences, not just this call's: a coexisting direct
-            # put(ttl_s=...) sequence rides the burst too, and its deadline
-            # deserves the same bounded overshoot
-            if len(self.admission) or any(s.deadline is not None and not s.done
-                                          for s in self.manager.seqs.values()):
-                k = min(k, self.BURST_DEADLINE_SLICE)
-            if k >= 2:
+            k = self._fusion_window(uids, results, produced, max_new_tokens)
+            fusible = False
+            if k >= fusion_min:
+                # cheap host-side applicability check BEFORE paying a pipeline
+                # flush: the burst needs a pure-decode live set that fits one
+                # ragged batch (decode_burst re-verifies pool capacity itself)
+                decoding, prefilling = self.scheduler.live_split(self.manager)
+                fusible = (bool(decoding) and not prefilling
+                           and len(decoding) <= self.scheduler.max_seqs)
+            if fusible and self._inflight is not None:
+                # the burst's bookkeeping finalizes sequences host-side:
+                # absorb the in-flight step first, then re-measure the window
+                self.counters.flushes += 1
+                absorb(self._settle_inflight())
+                k = self._fusion_window(uids, results, produced, max_new_tokens)
+            if fusible and k >= fusion_min:
                 burst = self.decode_burst(k, greedy=greedy, eos_token_id=eos_token_id)
                 if burst:
                     for uid, toks in burst.items():
@@ -556,70 +792,27 @@ class InferenceEngineV2:
                                             "eos" if hit_eos else "max_new_tokens")
                     continue
 
-            stepped = self.step(greedy=greedy)
-
-            for uid, reason in list(self.manager.failures.items()):
-                if uid in my and uid not in results:
-                    if strict:
-                        raise RuntimeError(f"request {uid} failed: {reason}")
-                    self._record_resilience("serving_request_failed", uid=uid,
-                                            reason=reason)
-                    seq = self.manager.seqs.get(uid)
-                    results[uid] = RequestResult(
-                        uid=uid, status=FAILED, reason=reason,
-                        tokens=list(seq.tokens) if seq is not None else [])
-                    if seq is not None:
-                        self.manager.retire(uid, completed=False)
-                    # consume the entry: uids are reused across generate()
-                    # calls and a stale failure must not taint a fresh request
-                    self.manager.failures.pop(uid, None)
-
-            # sequences finished WITHOUT emitting this step: a decode capped at
-            # max_blocks_per_seq completes gracefully (length_capped — all its
-            # generated tokens are valid), an expired request was evicted by
-            # _expire_live, an exhausted preemption victim ends
-            for uid in list(self.manager.seqs):
-                if uid not in my or uid in results:
-                    continue
-                seq = self.manager.seqs[uid]
-                if not (seq.done and seq.finish_reason):
-                    continue
-                if seq.finish_reason == DEADLINE_EXPIRED:
-                    if strict:
-                        raise RuntimeError(f"request {uid} deadline_expired after "
-                                           f"producing {seq.generated_tokens} tokens")
-                    results[uid] = RequestResult(uid=uid, status=DEADLINE_EXPIRED,
-                                                 tokens=list(seq.tokens), retryable=True,
-                                                 reason="deadline expired while running",
-                                                 queue_wait_s=seq.queue_wait_s,
-                                                 preemptions=seq.preemptions)
-                    self.manager.retire(uid, completed=False)
-                elif seq.finish_reason == PREEMPT_REQUEUED_EXHAUSTED:
-                    self._record_resilience("serving_preempt_requeued_exhausted",
-                                            uid=uid, preemptions=seq.preemptions)
-                    if strict:
-                        raise RuntimeError(
-                            f"request {uid} preempted {seq.preemptions}x and evicted "
-                            f"(KV pool pressure); enlarge num_blocks or lower concurrency")
-                    results[uid] = RequestResult(
-                        uid=uid, status=PREEMPT_REQUEUED_EXHAUSTED,
-                        tokens=list(seq.tokens), retryable=True,
-                        reason=f"preempted {seq.preemptions}x under KV pressure",
-                        preemptions=seq.preemptions, queue_wait_s=seq.queue_wait_s)
-                    self.manager.retire(uid, completed=False)
-                else:  # length_capped: a graceful completion
-                    self._finish_ok(uid, results, seq.finish_reason)
-
-            for uid, tok in stepped.items():
-                if uid not in my or uid in results:
-                    continue
-                produced[uid] += 1
-                if produced[uid] >= max_new_tokens or (eos_token_id is not None
-                                                       and tok == eos_token_id):
-                    self._finish_ok(uid, results,
-                                    "eos" if (eos_token_id is not None
-                                              and tok == eos_token_id)
-                                    else "max_new_tokens")
+            if can_pipeline and not (len(self.admission) or self._any_live_deadline()):
+                # async step pipelining: dispatch step N, then absorb step
+                # N-1's tokens while the device executes N — host scheduling
+                # of step N+1 overlaps device execution of N
+                if (self._inflight is not None
+                        and all(produced[u] + (1 if u in self._inflight.row_of else 0)
+                                >= max_new_tokens
+                                for u in uids if u not in results)):
+                    # every unresolved request finishes the moment the
+                    # in-flight step lands — absorb it instead of dispatching
+                    # a guaranteed-overshoot step
+                    absorb(self._settle_inflight())
+                else:
+                    deferred = self._dispatch_step(greedy)
+                    prev, self._inflight = self._inflight, deferred
+                    absorb(prev.patch(self.manager) if prev is not None else {})
+            else:
+                if self._inflight is not None:
+                    self.counters.flushes += 1
+                    absorb(self._settle_inflight())
+                absorb(self.step(greedy=greedy))
 
             # ---- progress watchdog: a live-but-unschedulable engine must trip,
             # not spin.  The signature covers every observable scheduling input;
@@ -629,14 +822,137 @@ class InferenceEngineV2:
             last_sig = sig
             self._stall_streak = stall_streak
             if stall_streak >= cfg.stall_watchdog_steps:
+                if self._inflight is not None:
+                    absorb(self._settle_inflight())
                 self._handle_stall(my, results, strict)
                 stall_streak, last_sig = 0, None
                 self._stall_streak = 0
+
+        if self._inflight is not None:
+            # the final absorb resolved every request with a step still in
+            # flight (e.g. a coexisting put() sequence rode it): patch its
+            # placeholders so no PENDING_TOKEN ever escapes the loop
+            self._inflight.patch(self.manager)
+            self._inflight = None
+
+    def _fusion_window(self, uids: List[int], results: Dict[int, RequestResult],
+                       produced: Dict[int, int], max_new_tokens: int) -> int:
+        """Tokens worth fusing into one decode burst right now: the smallest
+        remaining budget across this call's live requests, sliced to
+        BURST_DEADLINE_SLICE while anything is queued or deadlined (ALL live
+        sequences, not just this call's — a coexisting direct put(ttl_s=...)
+        sequence rides the burst too and its deadline deserves the same
+        bounded overshoot)."""
+        live = [u for u in uids if u not in results]
+        k = min((max_new_tokens - produced[u] for u in live), default=0)
+        if len(self.admission) or self._any_live_deadline():
+            k = min(k, self.BURST_DEADLINE_SLICE)
+        return k
+
+    def _absorb_step(self, stepped: Dict[int, int], my: set,
+                     results: Dict[int, RequestResult], produced: Dict[int, int], *,
+                     max_new_tokens: int, eos_token_id: Optional[int],
+                     strict: bool) -> None:
+        """Fold one step's outcomes into per-request results: sampled-token
+        finishes (eos / max_new_tokens), failures, and evictions — exactly the
+        bookkeeping the synchronous loop ran inline after step().  The
+        pipelined loop feeds it the PREVIOUS step's materialized tokens."""
+        for uid, tok in stepped.items():
+            if uid not in my or uid in results:
+                continue
+            produced[uid] += 1
+            hit_eos = eos_token_id is not None and tok == eos_token_id
+            if produced[uid] >= max_new_tokens or hit_eos:
+                self._truncate_overshoot(uid)
+                self._finish_ok(uid, results, "eos" if hit_eos else "max_new_tokens")
+
+        for uid, reason in list(self.manager.failures.items()):
+            if uid in my and uid not in results:
+                if strict:
+                    raise RuntimeError(f"request {uid} failed: {reason}")
+                self._record_resilience("serving_request_failed", uid=uid,
+                                        reason=reason)
+                seq = self.manager.seqs.get(uid)
+                results[uid] = RequestResult(
+                    uid=uid, status=FAILED, reason=reason,
+                    tokens=list(seq.tokens) if seq is not None else [])
+                if seq is not None:
+                    self.manager.retire(uid, completed=False)
+                # consume the entry: uids are reused across generate()
+                # calls and a stale failure must not taint a fresh request
+                self.manager.failures.pop(uid, None)
+
+        # sequences finished WITHOUT emitting this step: a decode capped at
+        # max_blocks_per_seq completes gracefully (length_capped — all its
+        # generated tokens are valid), an expired request was evicted by
+        # _expire_live, an exhausted preemption victim ends
+        for uid in list(self.manager.seqs):
+            if uid not in my or uid in results:
+                continue
+            seq = self.manager.seqs[uid]
+            if not (seq.done and seq.finish_reason):
+                continue
+            if seq.finish_reason == DEADLINE_EXPIRED:
+                if strict:
+                    raise RuntimeError(f"request {uid} deadline_expired after "
+                                       f"producing {seq.generated_tokens} tokens")
+                results[uid] = RequestResult(uid=uid, status=DEADLINE_EXPIRED,
+                                             tokens=list(seq.tokens), retryable=True,
+                                             reason="deadline expired while running",
+                                             queue_wait_s=seq.queue_wait_s,
+                                             preemptions=seq.preemptions)
+                self.manager.retire(uid, completed=False)
+            elif seq.finish_reason == PREEMPT_REQUEUED_EXHAUSTED:
+                self._record_resilience("serving_preempt_requeued_exhausted",
+                                        uid=uid, preemptions=seq.preemptions)
+                if strict:
+                    raise RuntimeError(
+                        f"request {uid} preempted {seq.preemptions}x and evicted "
+                        f"(KV pool pressure); enlarge num_blocks or lower concurrency")
+                results[uid] = RequestResult(
+                    uid=uid, status=PREEMPT_REQUEUED_EXHAUSTED,
+                    tokens=list(seq.tokens), retryable=True,
+                    reason=f"preempted {seq.preemptions}x under KV pressure",
+                    preemptions=seq.preemptions, queue_wait_s=seq.queue_wait_s)
+                self.manager.retire(uid, completed=False)
+            else:  # length_capped: a graceful completion
+                self._finish_ok(uid, results, seq.finish_reason)
+
+    def _truncate_overshoot(self, uid: int) -> None:
+        """A request finishing on its step-N token may already have step N+1
+        in flight (pipelined dispatch): drop the in-flight placeholder so the
+        finished token list is exactly the synchronous loop's.  The stray
+        device-side KV write lands in blocks this retirement frees; any later
+        owner's prefill rewrites them before its lengths let them be read."""
+        d = self._inflight
+        if d is None or uid not in d.row_of:
+            return
+        seq = self.manager.seqs.get(uid)
+        if seq is not None and seq.tokens and seq.tokens[-1] == PENDING_TOKEN:
+            seq.tokens.pop()
+            seq.seen_tokens = min(seq.seen_tokens, len(seq.tokens))
+        d.drop_emit(uid)
+
+    def _settle_inflight(self) -> Dict[int, int]:
+        """Materialize and clear the in-flight step (no-op when none)."""
+        d, self._inflight = self._inflight, None
+        return d.patch(self.manager) if d is not None else {}
+
+    def _any_live_deadline(self) -> bool:
+        return any(s.deadline is not None and not s.done
+                   for s in self.manager.seqs.values())
 
     def _abandon(self, my: set, results: Dict[int, RequestResult]) -> None:
         """Strict-mode raise cleanup: reclaim every trace of this call so the
         engine is immediately reusable (blocks freed, queue drained, stale
         failure entries consumed)."""
+        if self._inflight is not None:
+            try:
+                # foreign (direct put()) sequences may hold placeholders from
+                # the aborted step — patch them before this call's teardown
+                self._inflight.patch(self.manager)
+            finally:
+                self._inflight = None
         for uid in list(self.manager.seqs):
             if uid in my:
                 self.manager.retire(uid, completed=False)
@@ -646,6 +962,44 @@ class InferenceEngineV2:
         self._stall_streak = 0  # the wedge was evicted with everything else
 
     # ------------------------------------------------- serving-loop internals
+    def _prewarm(self, max_new_tokens: int) -> None:
+        """Serve-time compile-cache prewarm: AOT-compile the forward buckets
+        this call's queued + live requests are about to hit (bounded by
+        ``serving_fastpath.prewarm_buckets``) so the first wave doesn't pay
+        mid-serve compile stalls.  Best-effort — any lowering failure falls
+        back to compile-on-first-step."""
+        fp = self.fastpath
+        if not fp.enabled or fp.prewarm_buckets <= 0 or self.tp > 1:
+            return
+        depth, max_prompt = self.admission.queued_stats()
+        live = self.manager.live_uids()
+        for uid in live:
+            max_prompt = max(max_prompt, len(self.manager.seqs[uid].tokens))
+        n_total = min(depth + len(live), self.scheduler.max_seqs)
+        if n_total <= 0 or max_prompt <= 0:
+            return
+        bs = self.manager.block_size
+        w_prefill = self._stepped_width(-(-(max_prompt + 1) // bs))
+        w_decode = self._stepped_width(-(-(max_prompt + 1 + max_new_tokens) // bs))
+        n_b = self._bucket(n_total)
+        t_pf = self._bucket(max(1, min(self.scheduler.token_budget, max_prompt)))
+        candidates = [(n_b, 1, w_prefill), (n_b, 1, w_decode),
+                      (n_b, t_pf, w_prefill), (n_b, t_pf, w_decode)]
+        warmed = 0
+        for n, t, b in candidates:
+            if warmed >= fp.prewarm_buckets:
+                break
+            if (n, t, b) in self._fwd_cache:
+                continue
+            try:
+                self._aot_compile_fwd(n, t, b)
+            except Exception as e:
+                from ...utils.logging import warning_once
+                warning_once(f"serving fastpath: prewarm of bucket {(n, t, b)} "
+                             f"failed ({e}); falling back to on-demand compile")
+                return
+            warmed += 1
+
     def _finish_ok(self, uid: int, results: Dict[int, RequestResult],
                    finish_reason: str) -> None:
         seq = self.manager.seqs[uid]
@@ -791,4 +1145,7 @@ class InferenceEngineV2:
             # so a momentary `stalled` boolean could never be caught True)
             "stall_streak": self._stall_streak,
             "stalls_total": self.stalls_total,
+            # host-link counters (ISSUE 5): the serve loop's orchestration
+            # cost, for probes that watch syncs-per-token drift
+            "fastpath": self.counters.snapshot(),
         }

@@ -1,0 +1,280 @@
+"""Serving fast path — device-resident batch state + deferred host syncs.
+
+The v2 ragged engine's serve loop used to rebuild its whole padded batch on
+the host every step (one ``np`` rebuild + four ``jnp.asarray`` uploads) and
+then block on ``np.asarray(toks)`` before it could schedule the next step —
+pure orchestration overhead that left a ~20x gap between the fused decode
+burst and the continuous-batching loop (BENCH_r05: 1907 vs 90.4 tok/s).
+This module holds the three host-link levers the engine composes:
+
+- :class:`DeviceBatchState` — persistent donated device buffers per
+  ``(n_seqs, chunk, table_width)`` bucket (tokens / n_tokens / start_pos /
+  block tables), updated by ONE jitted scatter of the rows that actually
+  changed since the previous step (admissions, retirements, new tokens), so
+  steady-state steps move O(changed seqs) ints across the host link instead
+  of re-uploading the full padded batch.
+- :class:`DeferredTokens` — the sanctioned deferred-sync handle for sampled
+  tokens.  The engine appends :data:`PENDING_TOKEN` placeholders at dispatch
+  time and patches them when the handle is materialized — one step later in
+  the pipelined serve loop, immediately in the synchronous ``step()`` API.
+  :func:`materialize` is the ONE place v2 serving code converts a device
+  value to host; dslint's ``host-sync-in-hot-path`` rule flags any direct
+  ``np.asarray`` on step results elsewhere under ``inference/v2/``.
+- :class:`ServeCounters` — host-sync / dispatch / upload / compile counters
+  that make the win provable (the fastpath tests assert <=1 host sync per
+  serve-loop iteration in steady-state decode and a bounded compile count
+  across a mixed-arrival scenario; bench.py reports syncs-per-token).
+
+Nothing here schedules or owns sequences — that stays in the scheduler and
+the ragged manager; this is purely the host<->device traffic layer.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# host-side placeholder for a sampled-but-not-yet-fetched token.  Negative so
+# it can never collide with a real vocab id; it only ever appears as the LAST
+# entry of a live sequence's token list between dispatch and materialize.
+PENDING_TOKEN = -1
+
+# device-side mirror sentinel for a token slot that is fed on-device from the
+# previous step's sampled tokens (the host never knows the value, so the
+# mirror records "fed" instead of a real id and the diff never tries to
+# re-upload it)
+FED_SENTINEL = np.int32(-(2**31) + 1)
+
+
+class ServeCounters:
+    """Lifetime counters for the serve loop's host-link behavior.
+
+    ``host_syncs``   device->host materializations (the expensive round-trips)
+    ``dispatches``   device program launches (forward / pick / burst / scatter)
+    ``uploads``      host->device transfers issued
+    ``upload_ints``  int32 elements moved host->device by those transfers
+    ``compiles``     distinct compiled programs (bucket shapes) built so far
+    ``loop_iterations`` serve-loop iterations observed
+    ``step_tokens`` / ``burst_tokens``  tokens emitted via stepwise vs fused
+    ``flushes``      pipeline flushes forced by wave boundaries
+    """
+
+    FIELDS = ("host_syncs", "dispatches", "uploads", "upload_ints", "compiles",
+              "loop_iterations", "step_tokens", "burst_tokens", "flushes")
+
+    def __init__(self):
+        for f in self.FIELDS:
+            setattr(self, f, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {f: int(getattr(self, f)) for f in self.FIELDS}
+
+    def delta_since(self, snap: Dict[str, int]) -> Dict[str, int]:
+        return {f: int(getattr(self, f)) - snap.get(f, 0) for f in self.FIELDS}
+
+
+def materialize(dev_array, counters: Optional[ServeCounters] = None) -> np.ndarray:
+    """THE sanctioned device->host sync for v2 serving step results.
+
+    Every fetch of sampled tokens / done masks funnels through here so the
+    cost is (a) counted and (b) statically auditable — dslint's
+    host-sync-in-hot-path rule treats this helper as the one legal idiom and
+    flags direct ``np.asarray`` on step results anywhere else in
+    ``inference/v2/``.
+    """
+    if counters is not None:
+        counters.host_syncs += 1
+    # no suppression needed: the rule itself recognizes materialize() as the
+    # sanctioned deferred-sync helper (tools/staticcheck/rules.py)
+    return np.asarray(dev_array)
+
+
+@dataclasses.dataclass
+class DeferredTokens:
+    """Handle to one dispatched step's sampled tokens still on device.
+
+    ``emits``  [(uid, position_in_seq_tokens, batch_row)] for every sequence
+    that produced a next token this step (finished prefill or decoded) — the
+    positions hold :data:`PENDING_TOKEN` until :meth:`wait` patches them.
+    ``row_of`` maps uid -> batch row for on-device feeding of the NEXT step's
+    input tokens (the value never visits the host).
+    """
+    toks_dev: object
+    emits: List[Tuple[int, int, int]]
+    row_of: Dict[int, int]
+    counters: Optional[ServeCounters] = None
+    _cached: Optional[np.ndarray] = None
+
+    def wait(self) -> np.ndarray:
+        """Materialize the sampled tokens (idempotent)."""
+        if self._cached is None:
+            self._cached = materialize(self.toks_dev, self.counters)
+        return self._cached
+
+    def patch(self, manager) -> Dict[int, int]:
+        """Write the real token values over the placeholders and return the
+        ``{uid: token}`` map of sequences that emitted this step.
+
+        Sequences that vanished (retired/evicted mid-flight) are skipped;
+        sequences whose placeholder was already truncated (finish overshoot)
+        are skipped too — the patch keys on the recorded position still
+        holding :data:`PENDING_TOKEN`.
+        """
+        toks = self.wait()
+        out: Dict[int, int] = {}
+        for uid, pos, row in self.emits:
+            seq = manager.seqs.get(uid)
+            if seq is None:
+                continue
+            tok = int(toks[row])
+            if pos < len(seq.tokens) and seq.tokens[pos] == PENDING_TOKEN:
+                seq.tokens[pos] = tok
+            out[uid] = tok
+        return out
+
+    def drop_emit(self, uid: int) -> None:
+        """Forget a uid's pending emit (its overshoot token was truncated)."""
+        self.emits = [e for e in self.emits if e[0] != uid]
+        self.row_of.pop(uid, None)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """One bucket's persistent device arrays plus their host mirror."""
+    tokens: object          # device [n, t] int32
+    n_tokens: object        # device [n] int32
+    start_pos: object       # device [n] int32
+    tables: object          # device [n, b] int32
+    mirror: np.ndarray      # host [n, 1 + t + 2 + b] packed rows
+    active_rows: int = 0
+
+
+def round_up_pow2(n: int) -> int:
+    """Next power of two >= n — the ONE bucketing primitive shared by batch
+    shapes (engine ``_bucket``) and scatter-row padding, so the two can never
+    silently diverge and multiply compiled shapes."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class DeviceBatchState:
+    """Per-bucket persistent batch buffers with incremental scatter updates.
+
+    Rows are packed host-side as ``[tokens(t) | n_tokens | start_pos |
+    tables(b)]`` so the per-step delta is ONE ``[m, 3 + t + b]`` int32 upload
+    (changed-row indices ride in column 0) and ONE donated scatter dispatch,
+    instead of four full-batch uploads.  The host mirror tracks exactly what
+    the device holds, so shrinking batches neutralize their stale rows
+    (n_tokens=0, tables=trash) without ever re-uploading unchanged ones —
+    a stale row left live would write KV into blocks the allocator may have
+    handed to another sequence.
+    """
+
+    def __init__(self, counters: ServeCounters):
+        self.counters = counters
+        self._slots: Dict[Tuple[int, int, int], _Slot] = {}
+        self._scatter_shapes: set = set()
+        self._feed_shapes: set = set()
+        self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0, 1, 2, 3))
+        self._feed = jax.jit(self._feed_impl, donate_argnums=(0,))
+
+    @staticmethod
+    def _scatter_impl(tokens, n_tokens, start_pos, tables, packed):
+        t = tokens.shape[1]
+        idx = packed[:, 0]
+        return (tokens.at[idx].set(packed[:, 1:1 + t]),
+                n_tokens.at[idx].set(packed[:, 1 + t]),
+                start_pos.at[idx].set(packed[:, 2 + t]),
+                tables.at[idx].set(packed[:, 3 + t:]))
+
+    @staticmethod
+    def _feed_impl(tokens, toks_prev, pairs):
+        # pairs [m, 2]: (dst_row, src_row) — the next step's input token IS
+        # the previous step's sampled token; it never visits the host
+        return tokens.at[pairs[:, 0], 0].set(toks_prev[pairs[:, 1]])
+
+    # ------------------------------------------------------------------ slots
+    def slot(self, key: Tuple[int, int, int], trash_block: int) -> _Slot:
+        s = self._slots.get(key)
+        if s is None:
+            n, t, b = key
+            mirror = np.zeros((n, 3 + t + b), np.int32)
+            mirror[:, 0] = np.arange(n)
+            mirror[:, 3 + t:] = trash_block
+            s = _Slot(tokens=jnp.zeros((n, t), jnp.int32),
+                      n_tokens=jnp.zeros((n,), jnp.int32),
+                      start_pos=jnp.zeros((n,), jnp.int32),
+                      tables=jnp.full((n, b), trash_block, jnp.int32),
+                      mirror=mirror)
+            self._slots[key] = s
+        return s
+
+    # ----------------------------------------------------------------- update
+    def update(self, key: Tuple[int, int, int], rows: List[Tuple[int, np.ndarray]],
+               n_active: int, trash_block: int) -> _Slot:
+        """Scatter ``rows`` ([(row_index, packed_row)]) into the bucket's
+        device buffers, neutralizing any previously-active row beyond
+        ``n_active``.  Unchanged rows (mirror match) cost nothing."""
+        s = self.slot(key, trash_block)
+        n, t, b = key
+        changed: List[np.ndarray] = []
+        for i, packed in rows:
+            if not np.array_equal(packed[1:], s.mirror[i, 1:]):
+                changed.append(packed)
+                s.mirror[i, 1:] = packed[1:]
+        neutral = None
+        for i in range(n_active, s.active_rows):
+            if neutral is None:
+                neutral = np.zeros(3 + t + b, np.int32)
+                neutral[3 + t:] = trash_block
+            if not np.array_equal(neutral[1:], s.mirror[i, 1:]):
+                row = neutral.copy()
+                row[0] = i
+                changed.append(row)
+                s.mirror[i, 1:] = row[1:]
+        s.active_rows = n_active
+        if changed:
+            m = len(changed)
+            m_pad = round_up_pow2(m)
+            # pad with a repeat of the last row: duplicate scatter indices
+            # carry identical values, so the write order cannot matter
+            changed.extend([changed[-1]] * (m_pad - m))
+            packed = np.stack(changed)
+            sig = (key, m_pad)
+            if sig not in self._scatter_shapes:
+                self._scatter_shapes.add(sig)
+                self.counters.compiles += 1
+            self.counters.uploads += 1
+            self.counters.upload_ints += int(packed.size)
+            self.counters.dispatches += 1
+            s.tokens, s.n_tokens, s.start_pos, s.tables = self._scatter(
+                s.tokens, s.n_tokens, s.start_pos, s.tables, jnp.asarray(packed))
+        return s
+
+    def feed(self, key: Tuple[int, int, int], toks_prev,
+             pairs: List[Tuple[int, int]]) -> None:
+        """Feed previous-step sampled tokens into this step's input slots
+        entirely on device (``pairs``: (dst_row, src_row))."""
+        if not pairs:
+            return
+        s = self._slots[key]
+        m_pad = round_up_pow2(len(pairs))
+        arr = np.empty((m_pad, 2), np.int32)
+        arr[:len(pairs)] = pairs
+        arr[len(pairs):] = pairs[-1]  # duplicate writes carry identical values
+        sig = (key, int(toks_prev.shape[0]), m_pad)
+        if sig not in self._feed_shapes:
+            self._feed_shapes.add(sig)
+            self.counters.compiles += 1
+        self.counters.uploads += 1
+        self.counters.upload_ints += int(arr.size)
+        self.counters.dispatches += 1
+        s.tokens = self._feed(s.tokens, toks_prev, jnp.asarray(arr))
+
+    def forget(self) -> None:
+        """Drop every slot (tests / bucket-policy changes)."""
+        self._slots.clear()

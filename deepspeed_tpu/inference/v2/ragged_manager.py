@@ -169,8 +169,14 @@ class RaggedStateManager:
         need = (upto_tokens + self.block_size - 1) // self.block_size
         return max(0, need - len(seq.blocks))
 
-    def block_table_row(self, seq: SequenceDescriptor) -> np.ndarray:
-        row = np.full(self.max_blocks_per_seq, self.trash_block, np.int32)
+    def block_table_row(self, seq: SequenceDescriptor,
+                        width: Optional[int] = None) -> np.ndarray:
+        """Padded block-table row for the device batch; ``width`` bounds it to
+        the step's bucketed table width (the fast path packs rows at exactly
+        the compiled width instead of building max_blocks_per_seq and
+        slicing)."""
+        width = self.max_blocks_per_seq if width is None else width
+        row = np.full(width, self.trash_block, np.int32)
         row[:len(seq.blocks)] = seq.blocks
         return row
 

@@ -49,18 +49,27 @@ class SplitFuseScheduler:
         self._reserve_faulted = False  # last _reserve failed on an injected/transient
         # allocator fault (pool may have room) rather than genuine exhaustion
 
+    def live_split(self, manager: RaggedStateManager
+                   ) -> "tuple[List[SequenceDescriptor], List[SequenceDescriptor]]":
+        """Split the live, schedulable set into (decoding, prefilling) —
+        shared by schedule() and the engine's decode-fusion applicability
+        check (a pure-decode stable live set is what the fused burst needs)."""
+        decoding: List[SequenceDescriptor] = []
+        prefilling: List[SequenceDescriptor] = []
+        for uid in manager.live_uids():
+            seq = manager.seqs[uid]
+            if seq.pending_tokens <= 0:
+                continue
+            (prefilling if seq.pending_tokens > 1 else decoding).append(seq)
+        return decoding, prefilling
+
     def schedule(self, manager: RaggedStateManager) -> List[ScheduledChunk]:
         """Pick this step's ragged batch. Decodes first (latency), then prompt
         chunks to fill the budget; respects KV-pool availability."""
         budget = self.token_budget
         chunks: List[ScheduledChunk] = []
         self._requeued = set()
-        decoding, prefilling = [], []
-        for uid in manager.live_uids():
-            seq = manager.seqs[uid]
-            if seq.pending_tokens <= 0:
-                continue
-            (prefilling if seq.pending_tokens > 1 else decoding).append(seq)
+        decoding, prefilling = self.live_split(manager)
 
         starved: List[SequenceDescriptor] = []
         for seq in decoding:

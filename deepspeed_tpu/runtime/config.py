@@ -411,6 +411,31 @@ class ServingResilienceConfig(ConfigModel):
     stall_watchdog_steps: int = Field(100, ge=1)
 
 
+class ServingFastpathConfig(ConfigModel):
+    """Serving hot-path policy for the v2 ragged engine
+    (inference/v2/fastpath.py — no reference section; this models the
+    orchestration-overhead levers FastGen gets from CUDA graphs + pinned
+    ragged batch buffers, translated to XLA: persistent device-resident
+    batch state, deferred host syncs, and fused decode slices).
+
+    ``enabled`` turns the whole fast path off, falling back to the
+    rebuild-and-upload-per-step reference loop (the equivalence oracle the
+    fastpath tests diff against).  ``pipeline_depth=1`` defers the sampled-
+    token fetch by one step so host-side scheduling of step N+1 overlaps
+    device execution of step N (0 = fully synchronous); the pipeline
+    disengages automatically whenever admission tickets are queued or any
+    live sequence carries a deadline, so PR-4 eviction semantics are
+    bit-exact.  ``fusion_min_steps`` is the smallest remaining-token window
+    worth fusing into one on-device decode burst.  ``prewarm_buckets``
+    bounds how many (batch, chunk, table) bucket programs ``generate()``
+    AOT-compiles at intake so mid-wave recompiles stop stalling p95.
+    """
+    enabled: bool = True
+    pipeline_depth: int = Field(1, choices=(0, 1))
+    fusion_min_steps: int = Field(2, ge=2)
+    prewarm_buckets: int = Field(4, ge=0)
+
+
 class NebulaConfig(ConfigModel):
     """Reference: top-level "nebula" section (nebula/config.py) — enabling it
     selects the async (background-writer) checkpoint engine."""
@@ -516,6 +541,9 @@ class TrainingConfig(ConfigModel):
     # InferenceConfig carries the same section so a serving-only config and a
     # combined train+serve config spell it identically)
     serving_resilience: ServingResilienceConfig = Field(ServingResilienceConfig)
+    # serving hot-path knobs (device-resident batch state, step pipelining,
+    # adaptive decode fusion) — same dual-spelling contract as above
+    serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

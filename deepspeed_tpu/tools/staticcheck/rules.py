@@ -90,12 +90,21 @@ def _terminal_name(node: ast.AST) -> Optional[str]:
 class HostSyncInHotPath(Rule):
     name = "host-sync-in-hot-path"
     description = ("device→host sync (float/.item/np.asarray/jax.device_get/"
-                   "block_until_ready) inside per-step train/eval/serving code")
+                   "block_until_ready) inside per-step train/eval/serving code; "
+                   "under inference/v2/ any direct np.asarray/np.array/"
+                   "device_get/block_until_ready outside the sanctioned "
+                   "fastpath.materialize() deferred-sync helper")
 
     HOT_NAMES = {"train_batch", "_offload_train_batch", "eval_batch",
                  "decode_burst", "train_step"}
     ENGINE_METHOD_NAMES = {"step"}  # hot only when defined on an *Engine class
     NP_NAMES = {"np", "numpy", "onp"}
+    # the v2 serving package defers every step-result fetch through
+    # fastpath.materialize() (counted + auditable); a direct fetch anywhere
+    # else in inference/v2/ is an unsanctioned host sync even outside the
+    # classic hot-path function names
+    V2_PATH_FRAGMENT = "inference/v2/"
+    V2_SANCTIONED_FNS = {"materialize"}
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -107,24 +116,41 @@ class HostSyncInHotPath(Rule):
 
     def check(self, module, ctx):
         jit_roots = ctx.jit_roots(module)
+        in_v2 = self.V2_PATH_FRAGMENT in module.relpath.replace("\\", "/")
+        seen: Set[int] = set()  # a nested def is also walked via its parent
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if not self._is_hot(node) or id(node) in jit_roots:
+            if id(node) in jit_roots:
+                continue
+            hot = self._is_hot(node)
+            v2_scan = in_v2 and not hot and node.name not in self.V2_SANCTIONED_FNS
+            if not hot and not v2_scan:
                 continue
             # nested jitted defs run on device — their bodies can't host-sync
             skip = {id(n) for n in ast.walk(node)
                     if id(n) in jit_roots and n is not node}
             for sub in _walk_skipping(node, skip):
-                if not isinstance(sub, ast.Call):
+                if not isinstance(sub, ast.Call) or id(sub) in seen:
                     continue
-                msg = self._sync_call(sub)
-                if msg:
+                msg = self._sync_call(sub) if hot else self._v2_sync_call(sub)
+                if not msg:
+                    continue
+                seen.add(id(sub))
+                if hot:
                     yield self.finding(module, sub, msg + f" inside hot path '{node.name}' "
                                        "— every occurrence stalls dispatch for a host "
                                        "round-trip; hoist it, batch it into one fetch, or "
                                        "suppress with a reason if this is the step's one "
                                        "deliberate sync")
+                else:
+                    yield self.finding(module, sub, msg + f" in '{node.name}' under "
+                                       "inference/v2/ — serving step results must be "
+                                       "fetched through fastpath.materialize() (the "
+                                       "counted deferred-sync seam) so syncs stay "
+                                       "observable and deferrable; route it through the "
+                                       "helper or suppress with a reason if this is "
+                                       "host-only data")
 
     def _sync_call(self, call: ast.Call) -> Optional[str]:
         f = call.func
@@ -142,6 +168,22 @@ class HostSyncInHotPath(Rule):
             if f.attr == "device_get" and isinstance(f.value, ast.Name) and \
                     f.value.id == "jax":
                 return "jax.device_get() copies device values to host"
+        return None
+
+    def _v2_sync_call(self, call: ast.Call) -> Optional[str]:
+        """The inference/v2-wide subset: explicit array fetches only.
+        ``float()``/``.item()`` on host scalars are everywhere in gauge code
+        and are not device fetches, so the package-wide scan skips them."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "block_until_ready":
+                return ".block_until_ready() blocks on device execution"
+            if f.attr in ("asarray", "array") and isinstance(f.value, ast.Name) and \
+                    f.value.id in self.NP_NAMES:
+                return f"direct np.{f.attr}()"
+            if f.attr == "device_get" and isinstance(f.value, ast.Name) and \
+                    f.value.id == "jax":
+                return "direct jax.device_get()"
         return None
 
 

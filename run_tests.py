@@ -201,6 +201,63 @@ def serving_resilience_smoke():
     return 0
 
 
+def serving_fastpath_smoke():
+    """CI smoke for the serving fast path (ISSUE 5 acceptance), CPU-deterministic
+    counter/invariant assertions — never wall-clock: a mixed-arrival serve must
+    (a) keep host syncs bounded by serve-loop iterations + wave-boundary
+    flushes (steady-state decode pays <=1 sync per iteration), (b) emit most
+    tokens through fused decode bursts, (c) add ZERO compiled programs on an
+    identical warm rerun (the compile-count invariant behind stable p95), and
+    (d) produce byte-identical tokens to a ``serving_fastpath.enabled=False``
+    reference run."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, int(n)).tolist() for n in rng.integers(4, 16, 6)]
+
+    fast = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"}, **kw)
+    ref = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32",
+                                    "serving_fastpath": {"enabled": False}}, **kw)
+    out_fast = fast.generate(prompts, max_new_tokens=8)
+    out_ref = ref.generate(prompts, max_new_tokens=8)
+    assert out_fast == out_ref, "fast path diverged from the reference loop's tokens"
+
+    c1 = fast.counters.snapshot()
+    assert c1["host_syncs"] <= c1["loop_iterations"] + c1["flushes"], c1
+    assert c1["burst_tokens"] > c1["step_tokens"], c1  # decode fusion dominates
+    tokens_emitted = c1["burst_tokens"] + c1["step_tokens"]
+    assert c1["host_syncs"] < tokens_emitted, c1  # strictly sub-1-sync-per-token
+
+    # an identical second serve must hit only cached programs (no mid-wave
+    # recompiles: the p95 stability the bucket hysteresis + prewarm buy)
+    out2 = fast.generate(prompts, max_new_tokens=8)
+    assert out2 == out_fast, "warm rerun diverged"
+    c2 = fast.counters.delta_since(c1)
+    assert c2["compiles"] == 0, f"identical warm scenario recompiled: {c2}"
+
+    print(json.dumps({"serving_fastpath_smoke": "ok",
+                      "host_syncs": c1["host_syncs"],
+                      "loop_iterations": c1["loop_iterations"],
+                      "flushes": c1["flushes"],
+                      "compiled_programs": c1["compiles"],
+                      "burst_tokens": c1["burst_tokens"],
+                      "step_tokens": c1["step_tokens"],
+                      "warm_rerun_compiles": c2["compiles"]}))
+    return 0
+
+
 def run_smoke_lane(name: str, flag: str):
     """Run one of the smoke entry points as its own recorded lane (subprocess:
     each smoke pins its own env and must not contaminate the pytest lanes)."""
@@ -270,6 +327,7 @@ def run_lint_lane():
 def main():
     lanes = [run_lint_lane(),
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
+             run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
     with open("TESTS_LANES.json", "w") as fh:
@@ -285,6 +343,8 @@ if __name__ == "__main__":
         sys.exit(resilience_smoke())
     if "--serving-resilience-smoke" in sys.argv:
         sys.exit(serving_resilience_smoke())
+    if "--serving-fastpath-smoke" in sys.argv:
+        sys.exit(serving_fastpath_smoke())
     if "--lint" in sys.argv:
         sys.exit(run_lint_lane()["rc"])
     sys.exit(main())

@@ -75,6 +75,57 @@ class TestHostSyncInHotPath:
             """, self.RULE)
         assert out == []
 
+    # ---- inference/v2 package-wide scan (serving fastpath satellite):
+    # direct step-result fetches outside the sanctioned materialize() helper
+    def test_v2_flags_direct_asarray_outside_helper(self):
+        out = run("""
+            import numpy as np
+
+            def collect(dev):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/inference/v2/util.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "materialize" in out[0].message
+
+    def test_v2_sanctioned_materialize_is_clean(self):
+        out = run("""
+            import numpy as np
+
+            def materialize(dev, counters=None):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/inference/v2/fastpath.py")
+        assert out == []
+
+    def test_v2_scan_skips_host_scalars(self):
+        # float()/len() gauge math is not a device fetch — the package-wide
+        # scan only matches explicit array fetches
+        out = run("""
+            def gauges(manager):
+                return float(len(manager.seqs))
+            """, self.RULE, filename="deepspeed_tpu/inference/v2/engine_v2.py")
+        assert out == []
+
+    def test_same_asarray_outside_v2_stays_clean_in_cold_code(self):
+        out = run("""
+            import numpy as np
+
+            def collect(dev):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/runtime/foo.py")
+        assert out == []
+
+    def test_v2_hot_fn_broad_scan_no_duplicate_findings(self):
+        out = run("""
+            import numpy as np
+
+            class InferenceEngineV2:
+                def decode_burst(self, k):
+                    toks = np.asarray(self._toks)
+                    return float(toks.sum())
+            """, self.RULE, filename="deepspeed_tpu/inference/v2/engine_v2.py")
+        # hot-path scan applies (asarray + float), each flagged exactly once
+        assert rules_of(out) == ["host-sync-in-hot-path"] * 2
+
 
 # ------------------------------------------------------ traced-control-flow
 class TestTracedControlFlow:

@@ -31,21 +31,27 @@ def test_artifact_shape_and_mfu_extraction():
 
 def test_serving_scenario_stall_guard():
     """A scheduler that never emits must not spin the global budget away."""
+    from deepspeed_tpu.inference.v2.fastpath import ServeCounters
+
     class StuckEngine:
         def __init__(self):
             self.manager = type("M", (), {"seqs": {0: type("S", (), {
                 "pending_tokens": 1, "done": False})()}})()
+            self.counters = ServeCounters()
         def put(self, uids, prompts):
             pass
         def step(self):
             return {}
+        def decode_burst(self, k, **kw):
+            return None  # not fusible: the scenario must fall back to step()
         def flush(self, uid):
             pass
 
-    tokens, dt, lats, hit_stall = bench._run_serving_scenario(
+    tokens, dt, lats, hit_stall, link = bench._run_serving_scenario(
         StuckEngine(), [[1, 2]], {0: [0]}, max_new=4)
     assert tokens == 0 and lats == []  # bailed via the stall counter
     assert hit_stall  # and the bail is reported, not silent (ISSUE 4 review)
+    assert link["host_syncs"] == 0  # nothing ever reached the device
 
 
 def test_infinity_shape_ladder_budget_math():
