@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke lint lint-baseline
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -52,3 +52,11 @@ serving-resilience-smoke:
 # serving_fastpath.enabled=False reference loop; also a lane in run_tests.py
 serving-fastpath-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --serving-fastpath-smoke
+
+# request-lifecycle tracing (ISSUE 6): mixed-arrival serve with tracing ON —
+# every admitted request yields a complete JSONL span chain whose terminal
+# event matches its RequestResult status, TTFT/TBT/e2e/queue-wait histograms
+# fill, and the fastpath host-link counters are IDENTICAL to a tracing-off
+# run; also a lane in run_tests.py
+tracing-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --tracing-smoke

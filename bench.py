@@ -778,7 +778,11 @@ def measure_serving_mixed(on_tpu: bool):
         num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 16, 64, 8
 
     eng = InferenceEngineV2(llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
-                            config={"dtype": "bfloat16" if on_tpu else "float32"},
+                            config={"dtype": "bfloat16" if on_tpu else "float32",
+                                    # request-lifecycle tracing (ISSUE 6): the
+                                    # SLO percentiles below come from the
+                                    # tracer's streaming histograms
+                                    "serving_tracing": {"enabled": True}},
                             num_blocks=num_blocks, block_size=block_size,
                             max_blocks_per_seq=maxb, token_budget=budget,
                             max_seqs_per_step=max_seqs)
@@ -789,10 +793,24 @@ def measure_serving_mixed(on_tpu: bool):
                 n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
                 n_req // 4 + 12: list(range(3 * n_req // 4, n_req))}
     _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
+    # isolate the timed pass's SLO histograms from the warm pass's
+    # compile-stall-polluted TTFT samples
+    eng.tracer.reset_histograms()
     tokens, dt, lats, hit_stall, link = _run_serving_scenario(eng, prompts, arrivals, max_new)
     if not lats:
         return {"serving_mixed": "no tokens emitted"}
+    pct = eng.tracer.percentiles()
+    ms = lambda v: round(v * 1e3, 2)
+    slo = {}
+    for metric in ("ttft", "tbt"):
+        p = pct.get(metric)
+        if p:
+            slo.update({f"serving_mixed_{metric}_{k}": ms(v) for k, v in p.items()})
     return {"serving_mixed_tok_s": round(tokens / dt, 1),
+            # per-request SLO latency percentiles in ms (ISSUE 6): TTFT from
+            # request intake to first host-visible token, TBT between
+            # host-visible tokens (a fused burst of k = k samples of gap/k)
+            **slo,
             "serving_mixed_p50_step_ms": round(float(np.percentile(lats, 50)) * 1e3, 1),
             "serving_mixed_p95_step_ms": round(float(np.percentile(lats, 95)) * 1e3, 1),
             "serving_mixed_requests": n_req,

@@ -6,7 +6,8 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
-from ..runtime.config import ServingFastpathConfig, ServingResilienceConfig
+from ..runtime.config import (ServingFastpathConfig, ServingResilienceConfig,
+                              ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
@@ -46,6 +47,10 @@ class InferenceConfig(ConfigModel):
     # serving hot-path policy (device-resident batch buffers, async step
     # pipelining, adaptive decode fusion) — inference/v2/fastpath.py
     serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
+    # request-lifecycle tracing + SLO latency histograms + flight recorder —
+    # monitor/tracing.py wired through the v2 serving stack (same section
+    # spelling as runtime/config.py so train+serve configs share it)
+    serving_tracing: ServingTracingConfig = Field(ServingTracingConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

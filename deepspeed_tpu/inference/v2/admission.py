@@ -106,13 +106,18 @@ class AdmissionQueue:
     utilization, so rejection happens before the request ever owns a block.
 
     ``clock`` is injectable (fault tests drive a fake clock); defaults to
-    ``time.monotonic``.
+    ``time.monotonic``.  ``tracer`` (monitor/tracing.py RequestTracer) hears
+    about every intake decision: a ``queue_wait`` span opens at submit, a
+    shed becomes a terminal trace event, and shed/submit land in the
+    always-on flight recorder — the request-lifecycle chain starts at this
+    front door (ISSUE 6).
     """
 
-    def __init__(self, config=None, *, clock=time.monotonic):
+    def __init__(self, config=None, *, clock=time.monotonic, tracer=None):
         from ...runtime.config import ServingResilienceConfig
         self.config = config if config is not None else ServingResilienceConfig()
         self.clock = clock
+        self.tracer = tracer
         self._heap: List[Tuple[int, int, AdmissionTicket]] = []
         self._seq = 0  # FIFO tiebreak within a priority class
         self.submitted_total = 0
@@ -154,6 +159,15 @@ class AdmissionQueue:
                                   token_cap=token_cap)
         if reason is not None:
             self.shed_total += 1
+            if self.tracer is not None:
+                if self.tracer.enabled:
+                    # sheds never reach the ticket stamp below, so span
+                    # tracing pays one clock read here — otherwise a fresh
+                    # engine's shed records carry the stale last-ticked value
+                    self.tracer.tick(self.clock())
+                self.tracer.event("shed", uid=int(uid), code=reason.code)
+                self.tracer.on_shed(int(uid), reason.code, retryable=reason.retryable,
+                                    detail=reason.detail)
             return reason
         now = self.clock()
         ttl = ttl_s if ttl_s is not None else self.config.default_ttl_s
@@ -164,6 +178,13 @@ class AdmissionQueue:
                                  enqueue_t=now)
         heapq.heappush(self._heap, (ticket.priority, self._seq, ticket))
         self._seq += 1
+        if self.tracer is not None:
+            # the queue_wait span opens on the SAME clock value the ticket
+            # was stamped with — tracing adds no clock reads at this seam
+            self.tracer.tick(now)
+            self.tracer.event("submit", uid=ticket.uid, priority=ticket.priority)
+            self.tracer.on_submit(ticket.uid, now, prompt_len=len(ticket.prompt),
+                                  priority=ticket.priority)
         return None
 
     # ---------------------------------------------------------------- drain
@@ -176,6 +197,8 @@ class AdmissionQueue:
         """
         expired: List[AdmissionTicket] = []
         now = self.clock()
+        if self.tracer is not None:
+            self.tracer.tick(now)  # donate the already-read clock value
         while self._heap:
             _, _, ticket = heapq.heappop(self._heap)
             if ticket.deadline is not None and now >= ticket.deadline:

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from ...monitor.tracing import RequestTracer
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...utils.logging import log_dist
 from ..config import DTYPES as _DTYPES, load_inference_config
@@ -90,14 +91,22 @@ class InferenceEngineV2:
         # drive a fake one), preemption policy shared with the scheduler
         self.resilience = self.config.serving_resilience
         self._clock = clock if clock is not None else time.monotonic
-        self.admission = AdmissionQueue(self.resilience, clock=self._clock)
+        # request-lifecycle tracing (ISSUE 6): span chains per uid, SLO
+        # latency histograms (TTFT/TBT/e2e/queue-wait), and the always-on
+        # flight recorder — consumes ONLY the injectable clock, at points
+        # the host already touches, so tracing adds zero device syncs
+        self.tracer = RequestTracer(self.config.serving_tracing,
+                                    clock=self._clock, telemetry=telemetry)
+        self.admission = AdmissionQueue(self.resilience, clock=self._clock,
+                                        tracer=self.tracer)
         self._deadline_expired_total = 0
         self._stall_streak = 0
         self.stalls_total = 0  # lifetime watchdog trips (streaks are transient)
         self._queue_wait_s = 0.0
         self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step,
                                             telemetry=telemetry,
-                                            resilience=self.resilience)
+                                            resilience=self.resilience,
+                                            tracer=self.tracer)
         self.topology = topology
         self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
         self._warn_truncated_nucleus()
@@ -175,14 +184,37 @@ class InferenceEngineV2:
         (done, ``finish_reason: deadline_expired``, blocks reclaimed) before
         the next ragged batch is scheduled."""
         ttl = ttl_s if ttl_s is not None else self.resilience.default_ttl_s
-        deadline = self._clock() + ttl if ttl is not None else None
+        now = None
+        if ttl is not None or self.tracer.enabled:
+            # one clock read covers the whole batch: the deadline stamp, the
+            # flight-recorder tick, and the admit marks all share it
+            now = self._clock()
+            self.tracer.tick(now)
+        deadline = now + ttl if ttl is not None else None
         self._reset_table_width_if_idle()
         for uid, prompt in zip(uids, prompts):
             self.manager.add_sequence(int(uid), [int(t) for t in prompt],
                                       deadline=deadline)
+            self.tracer.event("admit", uid=int(uid), direct=True)
+            self.tracer.on_admit(int(uid), now, prompt_len=len(prompt))
 
     def flush(self, uid: int) -> None:
+        seq = self.manager.seqs.get(uid)
+        finish_reason = seq.finish_reason if seq is not None else None
+        failure = self.manager.failures.get(uid)
         self.manager.retire(uid)
+        # step()-level callers end a request's life here: terminal status
+        # mirrors retire()'s completion accounting (failures stay failed —
+        # fail() marks the seq done with finish_reason None — evictions keep
+        # their own status, everything else flushed-as-completed)
+        if failure is not None:
+            status = FAILED
+        elif finish_reason in (DEADLINE_EXPIRED, PREEMPT_REQUEUED_EXHAUSTED):
+            status = finish_reason
+        else:
+            status = OK
+        self.tracer.on_terminal(uid, status, finish_reason=finish_reason,
+                                reason=failure, t=self.tracer.last_now)
 
     def _reset_table_width_if_idle(self) -> None:
         """Fresh serve (no tracked sequences): drop the sticky table width so
@@ -301,6 +333,11 @@ class InferenceEngineV2:
         chunks = self.scheduler.schedule(self.manager)
         if not chunks:
             return None
+        self.tracer.event("dispatch", step=self.scheduler.steps, seqs=len(chunks),
+                          tokens=sum(c.n_tokens for c in chunks))
+        if self.tracer.enabled:  # don't build the chunk list for an early-return
+            self.tracer.on_chunks([(c.uid, c.n_tokens) for c in chunks],
+                                  step=self.scheduler.steps)
         n = self._bucket(len(chunks))
         t = self._bucket(max(c.n_tokens for c in chunks))
         # bucket the table width to the live maximum: the paged kernel's grid
@@ -358,7 +395,7 @@ class InferenceEngineV2:
         self.counters.step_tokens += len(emits)
         self._emit_serving_gauges(tokens_run=tokens_run)
         return DeferredTokens(toks_dev=toks_dev, emits=emits, row_of=row_of,
-                              counters=self.counters)
+                              counters=self.counters, tracer=self.tracer)
 
     def _step_reference(self, greedy: bool) -> Dict[int, int]:
         """The pre-fastpath step: full host-side batch rebuild + four uploads
@@ -368,6 +405,11 @@ class InferenceEngineV2:
         chunks = self.scheduler.schedule(self.manager)
         if not chunks:
             return {}
+        self.tracer.event("dispatch", step=self.scheduler.steps, seqs=len(chunks),
+                          tokens=sum(c.n_tokens for c in chunks))
+        if self.tracer.enabled:  # don't build the chunk list for an early-return
+            self.tracer.on_chunks([(c.uid, c.n_tokens) for c in chunks],
+                                  step=self.scheduler.steps)
         n = self._bucket(len(chunks))
         t = self._bucket(max(c.n_tokens for c in chunks))
         b = self._table_width_for(max(len(self.manager.seqs[c.uid].blocks)
@@ -406,6 +448,8 @@ class InferenceEngineV2:
                 seq.tokens.append(tok)
                 out[c.uid] = tok
         self.counters.step_tokens += len(out)
+        self.tracer.event("absorb", step=self.scheduler.steps, tokens=len(out))
+        self.tracer.on_tokens_map(out)
         self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
 
@@ -432,6 +476,9 @@ class InferenceEngineV2:
                   "fastpath_upload_ints": float(c.upload_ints),
                   "fastpath_burst_fraction":
                       c.burst_tokens / max(c.burst_tokens + c.step_tokens, 1)}
+        # SLO percentile gauges (ISSUE 6): ttft/tbt/e2e/queue_wait p50/p95/p99
+        # from the tracer's streaming histograms ({} while tracing is off)
+        gauges.update(self.tracer.gauge_fields())
         rps = self.telemetry.rate("v2_completed_requests",
                                   float(self.manager.completed_requests))
         if rps is not None:
@@ -643,6 +690,12 @@ class InferenceEngineV2:
             seq.seen_tokens += n_real
             self.counters.burst_tokens += n_real
             out[seq.uid] = produced
+        self.tracer.event("burst", step=self.scheduler.steps, k=k, seqs=len(live))
+        self.tracer.on_burst_tokens({uid: len(toks_) for uid, toks_ in out.items()})
+        # the burst is the dominant emission path: emit the serving gauges
+        # here too, so burst-heavy serves surface fresh SLO percentiles and
+        # burst-fraction instead of only dispatch-time snapshots
+        self._emit_serving_gauges(tokens_run=sum(len(v) for v in out.values()))
         return out
 
     # ----------------------------------------------------------- convenience
@@ -723,6 +776,10 @@ class InferenceEngineV2:
             # with nobody tracking their budget)
             self._abandon(my, results)
             raise
+        finally:
+            # flush the Chrome-trace export (if configured) even on a strict
+            # raise — the partial trace is exactly what the postmortem wants
+            self.tracer.write_chrome_trace()
         return results
 
     def _serve_loop(self, uids: List[int], my: set, results: Dict[int, RequestResult],
@@ -752,6 +809,7 @@ class InferenceEngineV2:
                 # or finalize sequences — catch host state up to the device
                 # first so PR-4 semantics match the synchronous loop exactly
                 self.counters.flushes += 1
+                self.tracer.event("flush", step=self.scheduler.steps, cause="wave")
                 absorb(self._settle_inflight())
             self._expire_live()
             self._pump_admissions(my, results, strict)
@@ -776,6 +834,7 @@ class InferenceEngineV2:
                 # the burst's bookkeeping finalizes sequences host-side:
                 # absorb the in-flight step first, then re-measure the window
                 self.counters.flushes += 1
+                self.tracer.event("flush", step=self.scheduler.steps, cause="fuse")
                 absorb(self._settle_inflight())
                 k = self._fusion_window(uids, results, produced, max_new_tokens)
             if fusible and k >= fusion_min:
@@ -811,6 +870,8 @@ class InferenceEngineV2:
             else:
                 if self._inflight is not None:
                     self.counters.flushes += 1
+                    self.tracer.event("flush", step=self.scheduler.steps,
+                                      cause="sync")
                     absorb(self._settle_inflight())
                 absorb(self.step(greedy=greedy))
 
@@ -872,6 +933,8 @@ class InferenceEngineV2:
                     raise RuntimeError(f"request {uid} failed: {reason}")
                 self._record_resilience("serving_request_failed", uid=uid,
                                         reason=reason)
+                self.tracer.event("failed", step=self.scheduler.steps, uid=uid)
+                self.tracer.on_terminal(uid, FAILED, reason=reason)
                 seq = self.manager.seqs.get(uid)
                 results[uid] = RequestResult(
                     uid=uid, status=FAILED, reason=reason,
@@ -901,6 +964,8 @@ class InferenceEngineV2:
                                              reason="deadline expired while running",
                                              queue_wait_s=seq.queue_wait_s,
                                              preemptions=seq.preemptions)
+                self.tracer.on_terminal(uid, DEADLINE_EXPIRED,
+                                        reason="deadline expired while running")
                 self.manager.retire(uid, completed=False)
             elif seq.finish_reason == PREEMPT_REQUEUED_EXHAUSTED:
                 self._record_resilience("serving_preempt_requeued_exhausted",
@@ -914,6 +979,9 @@ class InferenceEngineV2:
                     tokens=list(seq.tokens), retryable=True,
                     reason=f"preempted {seq.preemptions}x under KV pressure",
                     preemptions=seq.preemptions, queue_wait_s=seq.queue_wait_s)
+                self.tracer.on_terminal(
+                    uid, PREEMPT_REQUEUED_EXHAUSTED,
+                    reason=f"preempted {seq.preemptions}x under KV pressure")
                 self.manager.retire(uid, completed=False)
             else:  # length_capped: a graceful completion
                 self._finish_ok(uid, results, seq.finish_reason)
@@ -959,6 +1027,9 @@ class InferenceEngineV2:
         for uid in my:
             self.manager.failures.pop(uid, None)
         self.admission.drain()
+        # close any still-open traces of this call so the live-trace map and
+        # the strict caller's postmortem both see a terminal event
+        self.tracer.abort_all(my, reason="strict-mode abort")
         self._stall_streak = 0  # the wedge was evicted with everything else
 
     # ------------------------------------------------- serving-loop internals
@@ -1009,6 +1080,9 @@ class InferenceEngineV2:
                                      finish_reason=finish_reason,
                                      queue_wait_s=seq.queue_wait_s,
                                      preemptions=seq.preemptions)
+        self.tracer.event("finish", step=self.scheduler.steps, uid=uid,
+                          reason=finish_reason)
+        self.tracer.on_terminal(uid, OK, finish_reason=finish_reason)
         self.manager.retire(uid)  # reclaim KV blocks immediately, not at batch end
 
     def _expire_live(self) -> None:
@@ -1019,11 +1093,14 @@ class InferenceEngineV2:
         serve loop converts evicted sequences into results; step()-level
         callers observe ``done`` + the finish reason."""
         now = self._clock()
+        self.tracer.tick(now)  # donate the sweep's clock read to the recorder
         for seq in list(self.manager.seqs.values()):
             if seq.done or seq.deadline is None or now < seq.deadline:
                 continue
             self.manager.evict(seq, DEADLINE_EXPIRED)
             self._deadline_expired_total += 1
+            self.tracer.event("expire", step=self.scheduler.steps, uid=seq.uid,
+                              produced=seq.generated_tokens)
             self._record_resilience("serving_deadline_expired", uid=seq.uid,
                                     produced=seq.generated_tokens,
                                     seen_tokens=seq.seen_tokens)
@@ -1045,6 +1122,8 @@ class InferenceEngineV2:
                 # — something is live, and retiring it reopens the pump)
             ticket, expired = self.admission.pop_ready()
             for t in expired:
+                self.tracer.event("queue_expired", step=self.scheduler.steps,
+                                  uid=t.uid)
                 if t.uid in my and t.uid not in results:
                     self._deadline_expired_total += 1
                     self._record_resilience("serving_deadline_expired", uid=t.uid,
@@ -1054,19 +1133,35 @@ class InferenceEngineV2:
                     results[t.uid] = RequestResult(
                         uid=t.uid, status=DEADLINE_EXPIRED, retryable=True,
                         reason="deadline expired in the admission queue")
+                    self.tracer.on_terminal(
+                        t.uid, DEADLINE_EXPIRED, t=self.tracer.last_now,
+                        reason="deadline expired in the admission queue")
             if ticket is None:
                 break
-            wait = max(0.0, self._clock() - ticket.enqueue_t)
+            now = self._clock()
+            self.tracer.tick(now)
+            wait = max(0.0, now - ticket.enqueue_t)
             self._queue_wait_s = wait
+            # queue-wait histogram feeds health() percentiles even with span
+            # tracing off: the wait is already computed, pure host arithmetic
+            self.tracer.observe_queue_wait(wait)
             self.manager.add_sequence(ticket.uid, ticket.prompt,
                                       priority=ticket.priority,
                                       deadline=ticket.deadline, queue_wait_s=wait)
+            self.tracer.event("admit", step=self.scheduler.steps, uid=ticket.uid)
+            self.tracer.on_admit(ticket.uid, now, queue_wait_s=wait,
+                                 prompt_len=len(ticket.prompt))
         return False
 
     def _handle_stall(self, my: set, results: Dict[int, RequestResult],
                       strict: bool) -> None:
         cfg = self.resilience
         self.stalls_total += 1
+        self.tracer.event("stall", step=self.scheduler.steps,
+                          live_seqs=len(self.manager.seqs),
+                          free_blocks=self.manager.allocator.free_blocks)
+        # snapshot AFTER the stall event so the dump's flight-recorder tail
+        # includes the trip itself at the end of the history that led to it
         snapshot = self.state_snapshot()
         self._record_resilience("serving_stall",
                                 live_seqs=len(snapshot["live_uids"]),
@@ -1089,12 +1184,16 @@ class InferenceEngineV2:
                                              tokens=list(seq.tokens), retryable=True,
                                              preemptions=seq.preemptions,
                                              queue_wait_s=seq.queue_wait_s)
+                self.tracer.on_terminal(uid, FAILED, reason=reason,
+                                        t=self.tracer.last_now)
                 self.manager.retire(uid, completed=False)
         for ticket in self.admission.drain():
             if ticket.uid in my and ticket.uid not in results:
                 results[ticket.uid] = RequestResult(uid=ticket.uid, status=FAILED,
                                                     reason=reason + " (still queued)",
                                                     retryable=True)
+                self.tracer.on_terminal(ticket.uid, FAILED, t=self.tracer.last_now,
+                                        reason=reason + " (still queued)")
 
     def _progress_signature(self):
         return (tuple(sorted((uid, s.seen_tokens, len(s.tokens), s.done)
@@ -1123,6 +1222,9 @@ class InferenceEngineV2:
             "num_blocks": alloc.num_blocks,
             "queue_depth": len(self.admission),
             "scheduler_steps": self.scheduler.steps,
+            # the event history that LED here (ISSUE 6): the always-on flight
+            # recorder's tail rides every stall dump for postmortems
+            "flight_recorder": self.tracer.recorder.tail(),
         }
 
     def health(self) -> Dict[str, Any]:
@@ -1148,4 +1250,12 @@ class InferenceEngineV2:
             # host-link counters (ISSUE 5): the serve loop's orchestration
             # cost, for probes that watch syncs-per-token drift
             "fastpath": self.counters.snapshot(),
+            # SLO latency percentiles (ISSUE 6): queue_wait histogram is fed
+            # by the admission pump even with span tracing off; ttft/tbt/e2e
+            # fill in once serving_tracing.enabled is set
+            "queue_wait": self.tracer.queue_wait.snapshot(),
+            "latency": self.tracer.latency_snapshot(),
+            "tracing_enabled": self.tracer.enabled,
+            # the recent engine-event history (always on, bounded ring)
+            "flight_recorder": self.tracer.recorder.tail(32),
         }

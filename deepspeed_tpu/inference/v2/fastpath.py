@@ -100,12 +100,20 @@ class DeferredTokens:
     positions hold :data:`PENDING_TOKEN` until :meth:`wait` patches them.
     ``row_of`` maps uid -> batch row for on-device feeding of the NEXT step's
     input tokens (the value never visits the host).
+
+    ``tracer`` (monitor/tracing.py RequestTracer): the first :meth:`patch`
+    is the moment this step's tokens become host-visible — exactly where
+    per-request TTFT/TBT marks belong (ISSUE 6).  Reported once even though
+    patch() itself is idempotent (the burst path pre-patches the in-flight
+    handle and the serve loop settles it again).
     """
     toks_dev: object
     emits: List[Tuple[int, int, int]]
     row_of: Dict[int, int]
     counters: Optional[ServeCounters] = None
+    tracer: Optional[object] = None
     _cached: Optional[np.ndarray] = None
+    _trace_reported: bool = False
 
     def wait(self) -> np.ndarray:
         """Materialize the sampled tokens (idempotent)."""
@@ -132,6 +140,10 @@ class DeferredTokens:
             if pos < len(seq.tokens) and seq.tokens[pos] == PENDING_TOKEN:
                 seq.tokens[pos] = tok
             out[uid] = tok
+        if self.tracer is not None and not self._trace_reported:
+            self._trace_reported = True  # patch() is idempotent; marks are not
+            self.tracer.event("absorb", tokens=len(out))
+            self.tracer.on_tokens_map(out)
         return out
 
     def drop_emit(self, uid: int) -> None:

@@ -35,12 +35,16 @@ class ScheduledChunk:
 class SplitFuseScheduler:
 
     def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64,
-                 telemetry=None, resilience: Optional[ServingResilienceConfig] = None):
+                 telemetry=None, resilience: Optional[ServingResilienceConfig] = None,
+                 tracer=None):
         self.token_budget = token_budget
         self.max_seqs = max_seqs_per_step
         # TelemetryCollector (monitor/telemetry.py); every schedule() emits
         # the scheduler gauges through it when attached
         self.telemetry = telemetry
+        # RequestTracer (monitor/tracing.py): preempt/requeue land in the
+        # victim's span chain and the always-on flight recorder (ISSUE 6)
+        self.tracer = tracer
         self.resilience = resilience if resilience is not None else ServingResilienceConfig()
         self.steps = 0
         self.preempted_total = 0
@@ -143,6 +147,12 @@ class SplitFuseScheduler:
                     self._record("serving_preempt", uid=victim.uid, freed_blocks=freed,
                                  rolled_back_to=victim.seen_tokens,
                                  preemptions=victim.preemptions)
+                    if self.tracer is not None:
+                        self.tracer.event("preempt", step=self.steps, uid=victim.uid,
+                                          freed_blocks=freed)
+                        self.tracer.on_preempt(victim.uid, freed_blocks=freed,
+                                               rolled_back_to=victim.seen_tokens,
+                                               preemptions=victim.preemptions)
                 elif victims:
                     # every candidate exhausted its requeue budget: evict the
                     # newest one for good rather than deadlock the decodes
@@ -152,6 +162,9 @@ class SplitFuseScheduler:
                     self.preempted_total += 1
                     self._record("serving_preempt_exhausted", uid=victim.uid,
                                  freed_blocks=freed, preemptions=victim.preemptions)
+                    if self.tracer is not None:
+                        self.tracer.event("preempt_exhausted", step=self.steps,
+                                          uid=victim.uid, freed_blocks=freed)
                 else:
                     break  # nothing left to reclaim; the stall watchdog owns this
             if rescued:

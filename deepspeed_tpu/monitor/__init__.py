@@ -1,3 +1,4 @@
 """Monitoring (reference deepspeed/monitor/) + the unified telemetry collector."""
 from .monitor import Monitor, MonitorMaster
 from .telemetry import TelemetryCollector, detect_peak_flops_per_chip
+from .tracing import FlightRecorder, RequestTracer, StreamingHistogram

@@ -93,6 +93,7 @@ class TelemetryCollector:
                                     else detect_peak_flops_per_chip())
         self._flops_per_step: Any = _FLOPS_UNSET
         self._jsonl_fh = None
+        self._unflushed = 0
         self._tracing = False
         self._profile_done = False  # the capture window fires at most once
         self.records_written = 0
@@ -183,6 +184,16 @@ class TelemetryCollector:
                             if isinstance(v, (int, float)) and not isinstance(v, bool)])
         return record
 
+    def record_trace(self, trace: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """One completed request-lifecycle trace (monitor/tracing.py
+        RequestTracer) → a ``kind: trace`` JSONL record: uid, terminal
+        status, span chain, SLO marks (ttft_s/e2e_s/queue_wait_s)."""
+        if not self.enabled:
+            return None
+        record = {"kind": "trace", "timestamp": time.time(), **trace}
+        self._write_jsonl(record)
+        return record
+
     def record_events(self, events: List[Event]) -> None:
         """Fan events out to MonitorMaster (rank-0; no JSONL — events are the
         monitor-native shape, records are the JSONL-native shape)."""
@@ -212,8 +223,20 @@ class TelemetryCollector:
                 os.makedirs(parent, exist_ok=True)
             self._jsonl_fh = open(path, "a")
         self._jsonl_fh.write(json.dumps(record) + "\n")
-        self._jsonl_fh.flush()
         self.records_written += 1
+        # buffered flush policy (ISSUE 6 satellite): the default of 1 keeps
+        # the every-record durability tests rely on; high-rate trace streams
+        # raise jsonl_flush_every so flushes amortize off the serve loop
+        self._unflushed += 1
+        if self._unflushed >= self.config.jsonl_flush_every:
+            self._jsonl_fh.flush()
+            self._unflushed = 0
+
+    def flush_jsonl(self) -> None:
+        """Force out any buffered JSONL records (close() does this too)."""
+        if self._jsonl_fh is not None:
+            self._jsonl_fh.flush()
+        self._unflushed = 0
 
     # ------------------------------------------------- jax.profiler windows
     @property
@@ -280,8 +303,9 @@ class TelemetryCollector:
     def close(self) -> None:
         self.stop_trace()
         if self._jsonl_fh is not None:
-            self._jsonl_fh.close()
+            self._jsonl_fh.close()  # close() flushes any buffered records
             self._jsonl_fh = None
+        self._unflushed = 0
 
     def __del__(self):
         try:

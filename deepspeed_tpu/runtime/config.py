@@ -218,6 +218,11 @@ class TelemetryConfig(ConfigModel):
     """
     enabled: bool = False
     jsonl_path: Optional[str] = None
+    # flush the JSONL sink every N records (1 = after every record, the
+    # pre-tracing behavior tests rely on; raise it for high-rate record
+    # streams — per-request serving traces — so file flushes stay off the
+    # serve loop; close() always flushes whatever is buffered)
+    jsonl_flush_every: int = Field(1, ge=1)
     # -1 disables; [start, stop) in global steps, mirroring the reference's
     # flops_profiler profile_step single-shot trigger but as a window
     profile_step_start: int = Field(-1, ge=-1)
@@ -436,6 +441,43 @@ class ServingFastpathConfig(ConfigModel):
     prewarm_buckets: int = Field(4, ge=0)
 
 
+class ServingTracingConfig(ConfigModel):
+    """Request-lifecycle tracing + SLO latency histograms for the v2 ragged
+    engine (monitor/tracing.py wired through inference/v2 — no reference
+    section; this models the per-request observability vLLM/Orca-class
+    systems report: TTFT/TBT/e2e percentiles and per-request span chains).
+
+    ``enabled`` turns on per-uid span recording (queue_wait → prefill →
+    decode, requeue spans around preemptions, one terminal event matching the
+    request's ``RequestResult`` status) and the TTFT/TBT/e2e histograms.
+    Tracing consumes ONLY the engine's injectable clock at host-touch points
+    (admission, wave boundaries, token materialization) and adds zero device
+    syncs — the serving fast path's counter invariants hold with tracing on.
+    ``trace_jsonl`` exports each completed trace as a ``kind: trace`` record
+    through the attached telemetry collector's JSONL sink;
+    ``chrome_trace_path`` additionally buffers Chrome-trace-event JSON
+    (load in Perfetto / chrome://tracing) written by
+    ``RequestTracer.write_chrome_trace()`` (the engine writes it at the end
+    of each ``generate()`` call).
+
+    The flight recorder — a bounded ring of the last
+    ``flight_recorder_events`` engine events (dispatch/absorb/flush/burst/
+    preempt/shed/admit/expire/stall) dumped into ``ServingStalledError``
+    snapshots and ``health()`` — is ALWAYS on; the knob only sizes the ring.
+
+    Histogram buckets are logarithmic: ``histogram_buckets_per_decade``
+    buckets per decade starting at ``histogram_min_s`` seconds; quantiles
+    return deterministic bucket representatives (relative error bounded by
+    one bucket width), and same-shaped histograms merge exactly.
+    """
+    enabled: bool = False
+    trace_jsonl: bool = True
+    chrome_trace_path: Optional[str] = None
+    flight_recorder_events: int = Field(256, ge=16)
+    histogram_buckets_per_decade: int = Field(6, ge=1, le=100)
+    histogram_min_s: float = Field(1e-5, gt=0.0)
+
+
 class NebulaConfig(ConfigModel):
     """Reference: top-level "nebula" section (nebula/config.py) — enabling it
     selects the async (background-writer) checkpoint engine."""
@@ -544,6 +586,9 @@ class TrainingConfig(ConfigModel):
     # serving hot-path knobs (device-resident batch state, step pipelining,
     # adaptive decode fusion) — same dual-spelling contract as above
     serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
+    # request-lifecycle tracing, SLO latency histograms, flight recorder —
+    # same dual-spelling contract as above
+    serving_tracing: ServingTracingConfig = Field(ServingTracingConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -18,6 +18,11 @@ suppression reasons left in-tree for the survivors):
 - nondeterministic-rng: global ``random``/``np.random`` module state in
   library code (layouts/decisions diverge across ranks and reruns), and jax
   PRNG keys fed to two consumers without an intervening ``split``.
+- raw-clock-in-serving: direct ``time.time()``/``time.monotonic()``/
+  ``time.perf_counter()`` calls under ``inference/v2/`` — serving code must
+  consume the engine's injectable clock seam (``clock=...``, default bound to
+  ``time.monotonic`` WITHOUT calling it), or FakeClock-driven fault/deadline/
+  tracing tests silently read real wall-time and stop being deterministic.
 - silent-except: ``except Exception: pass`` — failures vanish instead of
   being logged once.
 - float64-in-compute: explicit float64 dtypes that silently become float32
@@ -440,6 +445,59 @@ class NondeterministicRNG(Rule):
                     f"two draws are perfectly correlated")
             else:
                 consumed[name] = line
+
+
+# --------------------------------------------------------------------------
+@register
+class RawClockInServing(Rule):
+    name = "raw-clock-in-serving"
+    description = ("direct time.time/monotonic/perf_counter CALL under "
+                   "inference/v2/ — serving timestamps must flow through the "
+                   "engine's injectable clock seam so FakeClock tests stay "
+                   "deterministic (binding time.monotonic as a default is the "
+                   "seam and stays legal)")
+
+    V2_PATH_FRAGMENT = "inference/v2/"
+    CLOCK_FNS = {"time", "monotonic", "perf_counter",
+                 "time_ns", "monotonic_ns", "perf_counter_ns"}
+
+    def check(self, module, ctx):
+        if self.V2_PATH_FRAGMENT not in module.relpath.replace("\\", "/"):
+            return
+        time_aliases: Set[str] = set()
+        from_imports: Dict[str, str] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name in self.CLOCK_FNS:
+                        from_imports[alias.asname or alias.name] = alias.name
+        if not time_aliases and not from_imports:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute) and f.attr in self.CLOCK_FNS and \
+                    isinstance(f.value, ast.Name) and f.value.id in time_aliases:
+                hit = f"{f.value.id}.{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id in from_imports:
+                hit = f"time.{from_imports[f.id]}()"
+            if hit is None:
+                continue
+            yield self.finding(
+                module, node,
+                f"direct {hit} under inference/v2/ — serving code must take "
+                f"timestamps from the engine's injectable clock (the "
+                f"``clock=...`` seam; binding time.monotonic as a DEFAULT is "
+                f"fine, calling it directly is not), otherwise FakeClock-driven "
+                f"deadline/trace tests read real wall-time and lose "
+                f"determinism; thread the injected clock through, or suppress "
+                f"with a reason if this is genuinely wall-clock-only code")
 
 
 # --------------------------------------------------------------------------

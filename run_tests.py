@@ -258,6 +258,85 @@ def serving_fastpath_smoke():
     return 0
 
 
+def tracing_smoke():
+    """CI smoke for request-lifecycle tracing (ISSUE 6 acceptance): a
+    mixed-arrival serve with ``serving_tracing.enabled`` must (a) yield a
+    complete JSONL span chain for every admitted request whose terminal event
+    matches its ``RequestResult`` status, (b) fill the TTFT/TBT/e2e/queue-wait
+    histograms, and (c) leave the serving fast path's host-link counters
+    IDENTICAL to a tracing-off run of the same scenario — tracing observes,
+    it never adds device syncs or recompiles."""
+    import os
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.telemetry import TelemetryCollector
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, int(n)).tolist() for n in rng.integers(4, 16, 6)]
+    # one over-cap prompt rides along so a shed terminal appears in the traces
+    prompts.append(list(range(1, 100)))
+
+    tmp = tempfile.mkdtemp(prefix="dstpu_tracing_smoke_")
+    jsonl = os.path.join(tmp, "traces.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl,
+                                                          jsonl_flush_every=8))
+    traced = InferenceEngineV2(llama, cfg, params, telemetry=collector,
+                               config={"dtype": "float32",
+                                       "serving_tracing": {"enabled": True}}, **kw)
+    plain = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"}, **kw)
+    results = {r.uid: r for r in traced.generate(prompts, max_new_tokens=8, strict=False)}
+    plain_results = {r.uid: r for r in plain.generate(prompts, max_new_tokens=8,
+                                                      strict=False)}
+    collector.close()
+
+    # tokens and statuses byte-identical to the untraced engine
+    assert {u: r.tokens for u, r in results.items()} == \
+        {u: r.tokens for u, r in plain_results.items()}, "tracing changed the tokens"
+    # fastpath invariants unchanged: the host-link counters of both runs match
+    c_on, c_off = traced.counters.snapshot(), plain.counters.snapshot()
+    assert c_on == c_off, f"tracing disturbed the host-link counters: {c_on} vs {c_off}"
+    assert c_on["host_syncs"] <= c_on["loop_iterations"] + c_on["flushes"], c_on
+
+    with open(jsonl) as fh:
+        records = [json.loads(line) for line in fh]
+    traces = {r["uid"]: r for r in records if r["kind"] == "trace"}
+    assert set(traces) == set(results), \
+        f"missing traces for {set(results) - set(traces)}"
+    for uid, r in results.items():
+        tr = traces[uid]
+        assert tr["status"] == r.status, f"uid {uid}: trace terminal {tr['status']} " \
+            f"!= result status {r.status}"
+        assert tr["events"] and tr["events"][-1][0] in (r.status, "shed"), tr["events"]
+        if r.status == "ok":  # complete span chain, every span closed
+            names = [s["name"] for s in tr["spans"]]
+            assert names[0] == "queue_wait" and "prefill" in names and "decode" in names
+            assert all(s["end"] is not None for s in tr["spans"]), tr["spans"]
+            assert tr["ttft_s"] is not None and tr["e2e_s"] >= tr["ttft_s"] >= 0
+    h = traced.health()
+    for metric in ("ttft", "tbt", "e2e", "queue_wait"):
+        assert h["latency"][metric]["count"] > 0, f"{metric} histogram is empty"
+        assert h["latency"][metric]["p50"] is not None
+    assert h["flight_recorder"], "flight recorder is empty"
+    n_ok = sum(1 for r in results.values() if r.status == "ok")
+    print(json.dumps({"tracing_smoke": "ok", "requests": len(results),
+                      "ok": n_ok, "shed": len(results) - n_ok,
+                      "trace_records": len(traces),
+                      "ttft_p50_s": round(h["latency"]["ttft"]["p50"], 5),
+                      "host_syncs": c_on["host_syncs"]}))
+    return 0
+
+
 def run_smoke_lane(name: str, flag: str):
     """Run one of the smoke entry points as its own recorded lane (subprocess:
     each smoke pins its own env and must not contaminate the pytest lanes)."""
@@ -328,6 +407,7 @@ def main():
     lanes = [run_lint_lane(),
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
+             run_smoke_lane("tracing_smoke", "--tracing-smoke"),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
     with open("TESTS_LANES.json", "w") as fh:
@@ -345,6 +425,8 @@ if __name__ == "__main__":
         sys.exit(serving_resilience_smoke())
     if "--serving-fastpath-smoke" in sys.argv:
         sys.exit(serving_fastpath_smoke())
+    if "--tracing-smoke" in sys.argv:
+        sys.exit(tracing_smoke())
     if "--lint" in sys.argv:
         sys.exit(run_lint_lane()["rc"])
     sys.exit(main())

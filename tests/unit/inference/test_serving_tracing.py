@@ -1,0 +1,392 @@
+"""Request-lifecycle tracing suite (ISSUE 6): span chains, SLO latency
+histograms, flight recorder, JSONL/Chrome export, and trace completeness
+under fault injection — all on the CPU backend with deterministic clocks."""
+
+import json
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2, ServingStalledError
+from deepspeed_tpu.inference.v2.admission import (DEADLINE_EXPIRED, FAILED, OK, SHED)
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.monitor.telemetry import TelemetryCollector
+from deepspeed_tpu.monitor.tracing import (FlightRecorder, RequestTracer,
+                                           StreamingHistogram)
+from deepspeed_tpu.runtime.config import ServingTracingConfig, TelemetryConfig
+from tests.unit.fault_injection_serving import (FakeClock, FaultyBlockedAllocator,
+                                                FrozenSequenceInjector)
+
+
+# ------------------------------------------------------- streaming histogram
+def test_histogram_deterministic_quantiles():
+    h = StreamingHistogram(buckets_per_decade=6, min_value=1e-5)
+    for v in (0.001, 0.002, 0.01, 0.1, 0.1, 0.1):
+        h.add(v)
+    # quantiles return the answering bucket's geometric midpoint — exact,
+    # reproducible values (what FakeClock-driven assertions rely on)
+    assert h.quantile(0.5) == h.representative(h._index(0.01))
+    assert h.quantile(0.95) == h.representative(h._index(0.1))
+    assert h.quantile(0.99) == h.representative(h._index(0.1))
+    assert h.count == 6 and h.max_seen == 0.1
+    p = h.percentiles()
+    assert set(p) == {"p50", "p95", "p99"} and p["p50"] < p["p95"] == p["p99"]
+
+
+def test_histogram_underflow_bucket_is_exact_zero():
+    h = StreamingHistogram()
+    for _ in range(5):
+        h.add(0.0)
+    h.add(2e-6)  # below min_value: underflow too
+    assert h.quantile(0.5) == 0.0 and h.quantile(0.99) == 0.0
+    assert h.count == 6
+
+
+def test_histogram_merge_exact_and_shape_checked():
+    a, b = StreamingHistogram(), StreamingHistogram()
+    both = StreamingHistogram()
+    for i, v in enumerate((0.001, 0.004, 0.02, 0.3, 1.0, 0.05)):
+        (a if i % 2 else b).add(v)
+        both.add(v)
+    a.merge(b)
+    assert a.counts == both.counts and a.count == both.count
+    assert a.percentiles() == both.percentiles()
+    with pytest.raises(ValueError, match="shape mismatch"):
+        a.merge(StreamingHistogram(buckets_per_decade=4))
+
+
+def test_histogram_empty_and_reset():
+    h = StreamingHistogram()
+    assert h.quantile(0.5) is None and h.percentiles() is None
+    assert h.snapshot()["count"] == 0 and h.snapshot()["p50"] is None
+    h.add(0.1)
+    h.reset()
+    assert h.count == 0 and h.percentiles() is None
+
+
+# ----------------------------------------------------------- flight recorder
+def test_flight_recorder_bounded_ring():
+    r = FlightRecorder(capacity=16)
+    for i in range(50):
+        r.record("dispatch", step=i, t=i * 0.1)
+    assert len(r) == 16 and r.events_total == 50
+    tail = r.tail()
+    assert [e["step"] for e in tail] == list(range(34, 50))  # the most recent 16
+    assert r.tail(4) == tail[-4:]
+    assert tail[-1]["event"] == "dispatch" and tail[-1]["seq"] == 50
+
+
+# ------------------------------------------------------------- tracer (unit)
+def _tracer(**cfg_kw):
+    clock = FakeClock(tick=0.0)
+    return RequestTracer(ServingTracingConfig(enabled=True, **cfg_kw),
+                         clock=clock), clock
+
+
+def test_tracer_span_chain_and_exact_slo_marks():
+    tr, _ = _tracer()
+    tr.on_submit(7, 1.0, prompt_len=4)
+    tr.on_admit(7, 1.5, queue_wait_s=0.5)
+    tr.on_chunks([(7, 4)])          # prefill opens (fake clock at 0.0+)
+    tr.on_tokens(7, 1, 2.0)          # first token: ttft = 2.0 - 1.0
+    tr.on_tokens(7, 4, 3.0)          # burst of 4: 4 tbt samples of 0.25
+    tr.on_terminal(7, OK, finish_reason="eos", t=3.5)
+    assert tr.ttft.count == 1
+    assert tr.ttft.quantile(0.5) == tr.ttft.representative(tr.ttft._index(1.0))
+    assert tr.tbt.count == 4
+    assert tr.tbt.quantile(0.99) == tr.tbt.representative(tr.tbt._index(0.25))
+    assert tr.e2e.count == 1
+    assert tr.e2e.quantile(0.5) == tr.e2e.representative(tr.e2e._index(2.5))
+    assert tr.live_uids() == [] and tr.completed_total == 1
+
+
+def test_tracer_disabled_reads_no_clock_and_keeps_recorder():
+    clock = FakeClock(tick=1.0)
+    tr = RequestTracer(ServingTracingConfig(enabled=False), clock=clock)
+    tr.on_submit(1, 0.0)
+    tr.on_admit(1)
+    tr.on_chunks([(1, 3)])
+    tr.on_tokens_map({1: 5})
+    tr.on_terminal(1, OK)
+    assert clock.calls == 0, "disabled tracing must not consume the clock"
+    tr.tick(4.25)
+    tr.event("dispatch", step=3, seqs=2)
+    tail = tr.recorder.tail()
+    assert tail and tail[-1]["t"] == 4.25 and tail[-1]["event"] == "dispatch"
+    assert tr.gauge_fields() == {}
+
+
+def test_tracer_terminal_is_idempotent():
+    tr, _ = _tracer()
+    tr.on_admit(3, 1.0)
+    tr.on_tokens(3, 1, 2.0)
+    tr.on_terminal(3, OK, t=2.5)
+    tr.on_terminal(3, FAILED, t=9.0)  # late duplicate: ignored
+    assert tr.completed_total == 1 and tr.e2e.count == 1
+
+
+# --------------------------------------------------------- engine scenarios
+def _tiny_engine(**kw):
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    defaults = dict(config={"dtype": "float32", "serving_tracing": {"enabled": True}},
+                    num_blocks=32, block_size=8, max_blocks_per_seq=8,
+                    token_budget=32, max_seqs_per_step=4)
+    defaults.update(kw)
+    return InferenceEngineV2(llama, cfg, params, **defaults)
+
+
+def test_trace_jsonl_complete_and_statuses_match(tmp_path):
+    """Acceptance: every request in a non-strict generate() yields a complete
+    JSONL trace whose terminal matches its RequestResult status."""
+    jsonl = str(tmp_path / "traces.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _tiny_engine(telemetry=collector)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], list(range(1, 90)), [8, 9]]  # idx 2 over cap
+    results = {r.uid: r for r in eng.generate(prompts, max_new_tokens=4, strict=False)}
+    collector.close()
+    assert results[2].status == SHED and results[0].status == OK
+    traces = {r["uid"]: r for r in map(json.loads, open(jsonl))
+              if r["kind"] == "trace"}
+    assert set(traces) == set(results)
+    for uid, r in results.items():
+        assert traces[uid]["status"] == r.status
+        assert all(s["end"] is not None for s in traces[uid]["spans"])
+    ok_trace = traces[0]
+    assert [s["name"] for s in ok_trace["spans"]][:1] == ["queue_wait"]
+    assert {"prefill", "decode"} <= {s["name"] for s in ok_trace["spans"]}
+    assert ok_trace["tokens"] == 4
+    assert ok_trace["events"][-1][0] == "ok"
+
+
+def test_fakeclock_percentiles_are_exact_and_reproducible(tmp_path):
+    """FakeClock-driven runs assert exact percentile values: the tracer's
+    histograms must equal a histogram rebuilt from the per-trace SLO marks,
+    and an identical rerun must reproduce them bit-for-bit."""
+    def run():
+        jsonl = str(tmp_path / f"t{run.n}.jsonl")
+        run.n += 1
+        collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+        eng = _tiny_engine(telemetry=collector, clock=FakeClock(tick=0.01))
+        eng.generate([[1, 2, 3], [4, 5, 6, 7], [8, 9]], max_new_tokens=4, strict=False)
+        collector.close()
+        records = [json.loads(l) for l in open(jsonl)]
+        return eng, [r for r in records if r["kind"] == "trace"]
+
+    run.n = 0
+    eng, traces = run()
+    rebuilt = StreamingHistogram(eng.tracer.ttft.buckets_per_decade,
+                                 eng.tracer.ttft.min_value)
+    for t in traces:
+        rebuilt.add(t["ttft_s"])
+    assert rebuilt.count == 3
+    assert eng.tracer.ttft.counts == rebuilt.counts
+    assert eng.tracer.ttft.percentiles() == rebuilt.percentiles()
+    first = eng.tracer.percentiles()
+    eng2, _ = run()
+    assert eng2.tracer.percentiles() == first  # deterministic end to end
+
+
+def test_preempted_request_trace_has_preempt_and_requeue_spans():
+    """A preempted request's trace contains the preempt event plus a closed
+    requeue span once it is rescheduled (fault-injection satellite)."""
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, kv_heads=2, seq=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(1))
+    eng = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32",
+                                    "serving_tracing": {"enabled": True}},
+                            num_blocks=6, block_size=8, max_blocks_per_seq=8,
+                            token_budget=16, max_seqs_per_step=4)  # 5 usable blocks
+    eng.put([0], [[1] * 16])
+    assert 0 in eng.step()           # uid 0 prefilled: 2 blocks
+    eng.put([1], [[2] * 30])
+    eng.manager.ensure_blocks(eng.manager.seqs[1], 24)  # pool now full
+    out = eng.step()                 # uid 0's decode preempts uid 1
+    assert 0 in out and eng.manager.seqs[1].preemptions >= 1
+    tr = eng.tracer.trace(1)
+    assert [e[0] for e in tr.events if e[0] == "preempt"], "no preempt event"
+    assert "requeue" in tr.open_span_names()  # waiting to be rescheduled
+    eng.flush(0)                     # free blocks so the victim reschedules
+    eng.step()                       # victim re-prefills: requeue span closes
+    requeues = [s for s in tr.spans if s.name == "requeue"]
+    assert requeues and requeues[-1].end is not None
+    assert ("resumed", ) not in tr.events  # sanity: events carry (name, t, fields)
+    assert any(e[0] == "resumed" for e in tr.events)
+    assert any(e["event"] == "preempt" for e in eng.tracer.recorder.tail())
+    eng.flush(1)
+    term = eng.tracer.trace(1)
+    assert term is None  # flush closed the trace
+
+
+def test_deadline_expired_trace_matches_result():
+    clock = FakeClock(tick=0.05)
+    eng = _tiny_engine(clock=clock)
+    results = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=64,
+                           strict=False, ttl_s=0.4)
+    assert results[0].status == DEADLINE_EXPIRED
+    # trace closed with the matching terminal (engine keeps no live trace)
+    assert eng.tracer.live_uids() == []
+    assert any(e["event"] == "expire" for e in eng.tracer.recorder.tail())
+
+
+def test_flush_of_failed_sequence_records_failed_terminal(tmp_path):
+    """manager.fail() leaves finish_reason None — flush() must still close
+    the trace as FAILED (not ok), and keep the e2e SLO histogram clean."""
+    jsonl = str(tmp_path / "failed.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _tiny_engine(telemetry=collector)
+    eng.put([0], [[1, 2, 3]])
+    eng.step()
+    eng.manager.fail(0, "injected forward error")
+    eng.flush(0)
+    collector.close()
+    traces = [r for r in map(json.loads, open(jsonl)) if r["kind"] == "trace"]
+    assert traces and traces[-1]["uid"] == 0
+    assert traces[-1]["status"] == FAILED
+    assert traces[-1]["reason"] == "injected forward error"
+    assert eng.tracer.e2e.count == 0  # failures never land e2e samples
+    assert eng.tracer.live_uids() == []
+
+
+def test_shed_trace_stamped_with_current_clock(tmp_path):
+    """A shed on a fresh engine must carry the shed-time clock value, not the
+    stale last-ticked 0.0 (the admit path's stamp never runs for sheds)."""
+    clock = FakeClock(start=100.0, tick=0.01)
+    jsonl = str(tmp_path / "shed.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _tiny_engine(clock=clock, telemetry=collector)
+    results = eng.generate([list(range(1, 90))], max_new_tokens=2, strict=False)
+    collector.close()
+    assert results[0].status == SHED
+    shed = [r for r in map(json.loads, open(jsonl)) if r["kind"] == "trace"][-1]
+    assert shed["status"] == SHED and shed["end_t"] >= 100.0
+    recorder_shed = [e for e in eng.tracer.recorder.tail() if e["event"] == "shed"]
+    assert recorder_shed and recorder_shed[-1]["t"] >= 100.0
+
+
+def test_stall_dump_contains_flight_recorder_tail():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_tracing": {"enabled": True},
+                               "serving_resilience": {"stall_watchdog_steps": 5}})
+    FrozenSequenceInjector(eng, 0).install()
+    with pytest.raises(ServingStalledError) as ei:
+        eng.generate([[1] * 40, [2, 3, 4]], max_new_tokens=4)
+    tail = ei.value.snapshot["flight_recorder"]
+    assert tail, "stall snapshot is missing the flight-recorder tail"
+    events = [e["event"] for e in tail]
+    assert "dispatch" in events, events
+    assert events[-1] == "stall"  # the trip itself ends the history
+    assert all("seq" in e and "t" in e and "step" in e for e in tail)
+
+
+def test_tracing_preserves_tokens_and_host_link_counters():
+    """Acceptance: with tracing on, tokens are byte-identical and the
+    fastpath counter invariants (host syncs, compiles, uploads) unchanged."""
+    prompts = [[1, 2, 3, 4], [5, 6], [7, 8, 9]]
+    on = _tiny_engine()
+    off = _tiny_engine(config={"dtype": "float32"})
+    out_on = on.generate(prompts, max_new_tokens=6)
+    out_off = off.generate(prompts, max_new_tokens=6)
+    assert out_on == out_off
+    assert on.counters.snapshot() == off.counters.snapshot()
+
+
+def test_tracing_survives_allocator_faults_with_complete_traces(tmp_path):
+    jsonl = str(tmp_path / "faulty.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _tiny_engine(telemetry=collector)
+    eng.manager.allocator = FaultyBlockedAllocator(32, fail_rate=0.4, seed=7)
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11] * 7]
+    results = {r.uid: r for r in eng.generate(prompts, max_new_tokens=6, strict=False)}
+    collector.close()
+    assert eng.manager.allocator.injected_failures > 0
+    traces = {r["uid"]: r for r in map(json.loads, open(jsonl)) if r["kind"] == "trace"}
+    assert set(traces) == set(results)
+    for uid, r in results.items():
+        assert traces[uid]["status"] == r.status == OK
+
+
+def test_chrome_trace_export(tmp_path):
+    chrome = str(tmp_path / "chrome.json")
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_tracing": {"enabled": True,
+                                                   "chrome_trace_path": chrome}})
+    eng.generate([[1, 2, 3], [4, 5]], max_new_tokens=3, strict=False)
+    doc = json.load(open(chrome))
+    events = doc["traceEvents"]
+    assert events
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in spans} >= {"queue_wait", "prefill", "decode"}
+    assert {e["tid"] for e in events} == {0, 1}  # one track per uid
+    assert all(e["dur"] >= 0 for e in spans)
+    marks = [e for e in events if e["ph"] == "i"]
+    assert any(e["name"] == "ok" for e in marks)
+
+
+def test_queue_wait_percentiles_in_health_without_span_tracing():
+    """Satellite: health() reports queue-wait p50/p95/p99 even with span
+    tracing disabled — the admission pump feeds the histogram for free."""
+    clock = FakeClock(tick=0.01)
+    eng = _tiny_engine(clock=clock,
+                       config={"dtype": "float32",
+                               "serving_resilience": {"max_live_seqs": 1}})
+    eng.generate([[1, 2, 3], [4, 5, 6], [7, 8]], max_new_tokens=3, strict=False)
+    h = eng.health()
+    assert h["tracing_enabled"] is False or h["tracing_enabled"] is True
+    qw = h["queue_wait"]
+    assert qw["count"] >= 3 and qw["p50"] is not None and qw["p99"] is not None
+    # max_live_seqs=1 serializes admission: later requests actually waited
+    assert qw["max"] > 0.0
+
+
+def test_health_latency_block_disabled_engine():
+    eng = _tiny_engine(config={"dtype": "float32"})  # tracing off
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    h = eng.health()
+    assert h["tracing_enabled"] is False
+    assert h["latency"]["ttft"]["count"] == 0          # no span tracing
+    assert h["queue_wait"]["count"] >= 1               # pump-fed regardless
+    assert h["flight_recorder"], "flight recorder must be always-on"
+
+
+def test_gauges_carry_slo_percentiles(tmp_path):
+    jsonl = str(tmp_path / "gauges.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _tiny_engine(telemetry=collector)
+    eng.generate([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=6, strict=False)
+    collector.close()
+    gauges = [r for r in map(json.loads, open(jsonl)) if r["kind"] == "gauges"
+              and r.get("prefix") == "Inference/Serving"]
+    assert gauges
+    last = gauges[-1]
+    assert "ttft_p50_s" in last and last["ttft_p50_s"] > 0
+    assert "tbt_p95_s" in last
+    # e2e samples land at terminal time — after the final gauges emission —
+    # so the freshest e2e percentiles live in health()
+    assert eng.health()["latency"]["e2e"]["count"] == 2
+    assert eng.health()["latency"]["e2e"]["p99"] > 0
+
+
+# ------------------------------------------------- telemetry buffered flush
+def test_jsonl_buffered_flush_policy(tmp_path):
+    jsonl = str(tmp_path / "buffered.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl,
+                                                          jsonl_flush_every=5))
+    for i in range(3):
+        collector.record_resilience("evt", step=i)
+    # buffered: nothing hits the file until the flush threshold
+    assert open(jsonl).read() == ""
+    for i in range(2):
+        collector.record_resilience("evt", step=3 + i)
+    assert len(open(jsonl).readlines()) == 5  # threshold crossed -> flushed
+    collector.record_resilience("tail", step=99)
+    collector.close()  # close always flushes the remainder
+    assert len(open(jsonl).readlines()) == 6
+
+
+def test_jsonl_default_flush_preserves_per_record_behavior(tmp_path):
+    jsonl = str(tmp_path / "unbuffered.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    collector.record_resilience("evt", step=0)
+    assert len(open(jsonl).readlines()) == 1  # visible immediately (default 1)
+    collector.close()

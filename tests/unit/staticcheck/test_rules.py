@@ -401,6 +401,79 @@ class TestNondeterministicRNG:
         assert out == []
 
 
+# ----------------------------------------------------- raw-clock-in-serving
+class TestRawClockInServing:
+    RULE = ["raw-clock-in-serving"]
+    V2 = "deepspeed_tpu/inference/v2/engine_v2.py"
+
+    @pytest.mark.parametrize("call", ["time.time()", "time.monotonic()",
+                                      "time.perf_counter()"])
+    def test_flags_direct_calls_under_v2(self, call):
+        out = run(f"""
+            import time
+
+            def intake(self, uid):
+                return {call}
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["raw-clock-in-serving"]
+        assert "injectable clock" in out[0].message
+
+    def test_from_import_and_alias_forms_flagged(self):
+        out = run("""
+            import time as _t
+            from time import monotonic as mono
+
+            def a():
+                return _t.perf_counter()
+
+            def b():
+                return mono()
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["raw-clock-in-serving"] * 2
+
+    def test_binding_as_default_is_the_legal_seam(self):
+        # referencing time.monotonic WITHOUT calling it is exactly how the
+        # injectable-clock seam is wired — must stay clean
+        out = run("""
+            import time
+
+            class AdmissionQueue:
+                def __init__(self, config=None, *, clock=time.monotonic):
+                    self.clock = clock
+
+            class InferenceEngineV2:
+                def __init__(self, clock=None):
+                    self._clock = clock if clock is not None else time.monotonic
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+    def test_injected_clock_calls_are_clean(self):
+        out = run("""
+            def pump(self):
+                now = self._clock()
+                return now + self.clock()
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+    def test_same_calls_outside_v2_stay_clean(self):
+        out = run("""
+            import time
+
+            def rate(self):
+                return time.perf_counter()
+            """, self.RULE, filename="deepspeed_tpu/monitor/telemetry.py")
+        assert out == []
+
+    def test_suppressible_with_reason(self):
+        out = run("""
+            import time
+
+            def wall_deadline():
+                return time.time()  # dslint: disable=raw-clock-in-serving  # wall-clock wanted: external SLA timestamps
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+
 # ------------------------------------------------------------- silent-except
 class TestSilentExcept:
     RULE = ["silent-except"]
