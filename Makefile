@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke lint lint-baseline
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke elastic-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -60,3 +60,12 @@ serving-fastpath-smoke:
 # run; also a lane in run_tests.py
 tracing-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --tracing-smoke
+
+# elastic fault tolerance (ISSUE 7): 4 real worker processes under the
+# elastic agent — crash one rank mid-step (gen 0), hang another inside a
+# stamped collective (gen 1, caught by heartbeat staleness, NOT exit codes) —
+# assert rescale 4→2→1, every generation resumes from the agent-pinned
+# consensus tag, losses match an uninterrupted reference run exactly, and
+# /proc shows zero orphaned workers; also a lane in run_tests.py
+elastic-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --elastic-smoke

@@ -43,12 +43,19 @@ def initialize(args=None,
     """
     from .runtime.engine import Engine
 
-    if dist_init_required is None or dist_init_required:
-        init_distributed()
-
     cfg = load_config(config)
     if args is not None and hasattr(args, "deepspeed_config") and args.deepspeed_config and config is None:
         cfg = load_config(args.deepspeed_config)
+
+    # fault-tolerance knobs must land BEFORE process-group setup: the retry
+    # loop they bound runs inside init_distributed() (agent-exported env
+    # still wins over these defaults)
+    comm.set_init_retry_defaults(cfg.fault_tolerance.init_retries,
+                                 cfg.fault_tolerance.init_retry_backoff_s)
+    comm.set_default_collective_timeout(cfg.fault_tolerance.collective_timeout_s)
+
+    if dist_init_required is None or dist_init_required:
+        init_distributed()
 
     fn = loss_fn
     if fn is None and model is not None:

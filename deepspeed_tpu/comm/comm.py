@@ -16,6 +16,8 @@ Every op is profiled through the CommsLogger (analog of ``timed_op`` comm.py:101
 """
 
 import functools
+import os
+import threading
 import time
 from typing import Optional, Sequence, Union
 
@@ -25,12 +27,108 @@ import numpy as np
 from jax import lax
 
 from ..parallel.mesh import MeshTopology, get_topology
+from ..runtime.heartbeat import (COLLECTIVE_TIMEOUT_ENV, INIT_RETRIES_ENV,
+                                 INIT_RETRY_BACKOFF_ENV, get_heartbeat)
 from ..utils.comms_logging import get_comms_logger
+from ..utils.env import env_float, env_int
 from ..utils.logging import logger, warning_once
 
 ReduceOp = type("ReduceOp", (), {"SUM": "sum", "AVG": "avg", "MAX": "max", "MIN": "min", "PRODUCT": "prod"})
 
 _INITIALIZED = False
+
+# -------------------------------------------------------- bounded collectives
+# Default wall-clock bound for HOST-LEVEL collectives (barrier and anything
+# routed through bounded_collective).  None = unbounded (the historical
+# behavior).  Set from config (fault_tolerance.collective_timeout_s via
+# initialize()/the engine), set_default_collective_timeout(), or the env the
+# elastic agent exports to its workers (collective_timeout_s agent param /
+# launcher --collective_timeout).
+_DEFAULT_COLLECTIVE_TIMEOUT_S: Optional[float] = None
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A host-level collective exceeded its wall-clock bound.
+
+    The whole point of bounding collectives: a rank stuck in (or absent from)
+    a collective otherwise deadlocks every peer SILENTLY — the job burns its
+    deadline with zero diagnostics.  This error names the collective, this
+    process's rank, and the elapsed time, so the supervisor (elastic agent)
+    gets a fast, attributable failure to restart from instead of a hang."""
+
+    def __init__(self, collective: str, rank: int, elapsed_s: float, timeout_s: float):
+        self.collective = collective
+        self.rank = rank
+        self.elapsed_s = elapsed_s
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"collective '{collective}' timed out on rank {rank} after "
+            f"{elapsed_s:.1f}s (timeout {timeout_s:.1f}s) — a peer likely "
+            f"crashed, hung, or entered a different collective; check the "
+            f"elastic agent's cross-rank hang snapshot for the stuck ranks")
+
+
+def set_default_collective_timeout(timeout_s: Optional[float]) -> None:
+    global _DEFAULT_COLLECTIVE_TIMEOUT_S
+    _DEFAULT_COLLECTIVE_TIMEOUT_S = None if timeout_s is None else float(timeout_s)
+
+
+def _resolve_timeout(timeout_s) -> Optional[float]:
+    if timeout_s is not None:
+        return float(timeout_s) if timeout_s > 0 else None
+    env_val = env_float(COLLECTIVE_TIMEOUT_ENV)
+    if env_val is not None:
+        return env_val if env_val > 0 else None
+    return _DEFAULT_COLLECTIVE_TIMEOUT_S
+
+
+def bounded_collective(fn, *args, timeout_s: Optional[float] = None,
+                       name: str = "collective", **kwargs):
+    """Run a blocking host-level collective with a wall-clock bound.
+
+    Stamps the heartbeat (``enter_collective(name)`` / ``exit_collective``)
+    around the wait so the agent's hang dump can NAME the collective each
+    rank sat in, then executes ``fn`` on a daemon worker thread and joins
+    with the resolved timeout.  On expiry raises
+    :class:`CollectiveTimeoutError`; the worker thread stays parked on the
+    wedged collective (there is no portable way to cancel it) — the expected
+    response is process exit + agent restart, which is exactly what the
+    error exists to trigger.  ``timeout_s=None`` falls back to the
+    module/env default; no default means a direct (unbounded) call, still
+    heartbeat-stamped."""
+    timeout = _resolve_timeout(timeout_s)
+    hb = get_heartbeat()
+    hb.enter_collective(name)
+    timed_out = False
+    try:
+        if timeout is None:
+            return fn(*args, **kwargs)
+        result: list = []
+        failure: list = []
+
+        def _run():
+            try:
+                result.append(fn(*args, **kwargs))
+            except BaseException as exc:  # noqa: BLE001 — re-raised on the caller thread below
+                failure.append(exc)
+
+        t0 = time.monotonic()
+        worker = threading.Thread(target=_run, name=f"dstpu-{name}", daemon=True)
+        worker.start()
+        worker.join(timeout)
+        if worker.is_alive():
+            timed_out = True
+            raise CollectiveTimeoutError(name, get_rank(), time.monotonic() - t0, timeout)
+        if failure:
+            raise failure[0]
+        return result[0]
+    finally:
+        # on timeout the worker thread is STILL wedged inside the collective:
+        # keep its name stamped so the agent's hang dump can attribute the
+        # deadlock (clearing it would erase exactly that diagnosis and reset
+        # the staleness clock on a rank that is not making progress)
+        if not timed_out:
+            hb.exit_collective()
 
 
 def init_distributed(dist_backend: str = "xla",
@@ -50,17 +148,71 @@ def init_distributed(dist_backend: str = "xla",
     global _INITIALIZED
     if _INITIALIZED:
         return
-    import os
     coord = (init_method or os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if coord:
         nproc = world_size if world_size > 0 else int(os.environ.get("WORLD_SIZE", "1"))
         pid = rank if rank >= 0 else int(os.environ.get("RANK", "0"))
-        jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+        _initialize_with_retries(coord, nproc, pid, timeout)
         if verbose:
             logger.info(f"jax.distributed initialized: process {pid}/{nproc} via {coord}")
     from ..utils import logging as _logging
     _logging.set_rank_provider(jax.process_index)
     _INITIALIZED = True
+
+
+# Module defaults for the process-group setup retry loop.  Set from config
+# (fault_tolerance.init_retries/init_retry_backoff_s, applied by
+# deepspeed_tpu.initialize() BEFORE init_distributed runs) via
+# set_init_retry_defaults(); the agent-exported env wins over both.
+_DEFAULT_INIT_RETRIES = 3
+_DEFAULT_INIT_RETRY_BACKOFF_S = 0.5
+
+
+def set_init_retry_defaults(retries: Optional[int] = None,
+                            backoff_s: Optional[float] = None) -> None:
+    """Default attempts/backoff for ``_initialize_with_retries`` (None keeps
+    the current value for that knob)."""
+    global _DEFAULT_INIT_RETRIES, _DEFAULT_INIT_RETRY_BACKOFF_S
+    if retries is not None:
+        _DEFAULT_INIT_RETRIES = max(int(retries), 0)
+    if backoff_s is not None:
+        _DEFAULT_INIT_RETRY_BACKOFF_S = max(float(backoff_s), 0.0)
+
+
+def _initialize_with_retries(coord: str, nproc: int, pid: int, timeout=None) -> None:
+    """``jax.distributed.initialize`` under bounded exponential-backoff
+    retries — process-group setup fails transiently in exactly the situations
+    elastic training creates (restarted coordinator not listening yet, a peer
+    of the previous generation still holding the port).  Attempts/backoff
+    come from the env the elastic agent exports (``DSTPU_INIT_RETRIES`` /
+    ``DSTPU_INIT_RETRY_BACKOFF_S``), falling back to the module defaults
+    config set via :func:`set_init_retry_defaults`; the last failure
+    propagates unchanged."""
+    retries = max(env_int(INIT_RETRIES_ENV, _DEFAULT_INIT_RETRIES), 0)
+    backoff = max(env_float(INIT_RETRY_BACKOFF_ENV, _DEFAULT_INIT_RETRY_BACKOFF_S), 0.0)
+    kwargs = {} if timeout is None else {"initialization_timeout": timeout}
+    for attempt in range(retries + 1):
+        try:
+            jax.distributed.initialize(coordinator_address=coord, num_processes=nproc,
+                                       process_id=pid, **kwargs)
+            return
+        except Exception as exc:
+            if attempt >= retries:
+                raise
+            # a failed initialize leaves jax's global distributed state
+            # assigned (client, and on rank 0 the coordinator service), so
+            # without a reset every later attempt would die on 'distributed
+            # .initialize should only be called once' instead of retrying
+            try:
+                jax.distributed.shutdown()
+            except Exception as reset_exc:
+                logger.debug(f"init_distributed: state reset between retries "
+                             f"raised {reset_exc!r} (continuing)")
+            delay = backoff * (2 ** attempt)
+            logger.warning(f"init_distributed: attempt {attempt + 1}/{retries + 1} "
+                           f"failed ({exc!r}); retrying in {delay:.2f}s")
+            if delay > 0:
+                time.sleep(delay)
 
 
 def is_initialized() -> bool:
@@ -86,13 +238,21 @@ def get_local_rank() -> int:
     return 0  # one process per host owns all local chips in JAX
 
 
-def barrier(group=None):
-    """Synchronize all processes/devices (reference comm.py:521)."""
-    x = jnp.zeros(())
-    x.block_until_ready()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("dstpu_barrier")
+def barrier(group=None, timeout_s: Optional[float] = None):
+    """Synchronize all processes/devices (reference comm.py:521).
+
+    Bounded: with a resolved timeout (arg > config/env default) a barrier a
+    peer never reaches raises :class:`CollectiveTimeoutError` instead of
+    blocking forever; the heartbeat records 'in barrier' either way."""
+
+    def _sync():
+        x = jnp.zeros(())
+        x.block_until_ready()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("dstpu_barrier")
+
+    return bounded_collective(_sync, timeout_s=timeout_s, name="barrier")
 
 
 # --------------------------------------------------------------------------

@@ -1,4 +1,4 @@
 """Elastic training (reference deepspeed/elasticity/)."""
-from .elastic_agent import DSElasticAgent, WorkerGroup
+from .elastic_agent import DSElasticAgent, WorkerGroup, select_consensus_tag
 from .elasticity import (ElasticityConfig, compute_elastic_config, get_best_candidates,
                          get_valid_gpus)

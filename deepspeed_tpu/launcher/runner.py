@@ -285,6 +285,44 @@ def build_launch_env(resources: Dict[str, int], master_addr: str, master_port: i
     }
 
 
+def run_elastic(args) -> int:
+    """Single-host elastic supervision (``--elastic N``): the agent spawns N
+    copies of the user script, watches exit codes AND per-rank heartbeats,
+    and on failure rescales to the next valid world size, pinning the new
+    generation to the newest checkpoint tag valid across all of its ranks."""
+    import tempfile
+
+    from ..elasticity import DSElasticAgent
+
+    elastic_config = None
+    if args.ds_config:
+        with open(args.ds_config) as fh:
+            elastic_config = json.load(fh).get("elasticity")
+    kwargs = {}
+    if args.heartbeat_timeout is not None:
+        kwargs.update(heartbeat_dir=tempfile.mkdtemp(prefix="dstpu_hb_"),
+                      heartbeat_timeout_s=args.heartbeat_timeout)
+    if args.collective_timeout is not None:
+        kwargs.update(collective_timeout_s=args.collective_timeout)
+    agent = DSElasticAgent(
+        [sys.executable, "-u", args.user_script] + list(args.user_args),
+        world_size=args.elastic, elastic_config=elastic_config,
+        max_restarts=args.max_restarts, checkpoint_dir=args.checkpoint_dir,
+        per_rank_checkpoints=args.per_rank_checkpoints,
+        verify_checkpoint_integrity=args.verify_checkpoint_integrity,
+        **kwargs)
+    logger.info(f"launching {args.elastic} workers under the elastic agent "
+                f"(max_restarts={args.max_restarts})")
+    rc = agent.run()
+    hb_dir = kwargs.get("heartbeat_dir")
+    if hb_dir:
+        if rc == 0:
+            shutil.rmtree(hb_dir, ignore_errors=True)  # don't leak /tmp stamps
+        else:
+            logger.warning(f"keeping heartbeat stamps for postmortem: {hb_dir}")
+    return rc
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="deepspeed_tpu launcher (reference bin/deepspeed)")
@@ -299,9 +337,40 @@ def main(argv=None):
     parser.add_argument("--mpi_interface", default="",
                         help="NIC for Open MPI's TCP BTL (omit to let OMPI pick)")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic", type=int, default=0, metavar="N",
+                        help="supervise N local worker processes under the elastic "
+                             "agent (heartbeat liveness, hang detection, rescale + "
+                             "checkpoint-pinned restart) instead of one exec")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--checkpoint_dir", default=None,
+                        help="with --elastic: restart generations resume from the "
+                             "newest tag valid across all ranks (DSTPU_RESUME_TAG)")
+    parser.add_argument("--per_rank_checkpoints", action="store_true",
+                        help="with --elastic: workers save node-locally to "
+                             "<checkpoint_dir>/rank<R>/ — consensus must walk every "
+                             "rank's dir (without this the walk sees the rank<R> "
+                             "subdirs as invalid tags and pins nothing)")
+    parser.add_argument("--verify_checkpoint_integrity", action="store_true",
+                        help="with --elastic: consensus tag selection also CRC-checks "
+                             "every rank's copy (a size-only check can pin a tag a "
+                             "worker's own verify_integrity pass then rejects)")
+    parser.add_argument("--heartbeat_timeout", type=float, default=None,
+                        help="with --elastic: a rank whose heartbeat stamp is older "
+                             "than this many seconds is treated as hung")
+    parser.add_argument("--collective_timeout", type=float, default=None,
+                        help="with --elastic: wall-clock bound (seconds) exported to "
+                             "workers (DSTPU_COLLECTIVE_TIMEOUT_S) so a wedged host "
+                             "collective raises CollectiveTimeoutError instead of "
+                             "deadlocking the generation")
+    parser.add_argument("--ds_config", default=None,
+                        help="with --elastic: ds config JSON whose 'elasticity' "
+                             "section constrains the valid world sizes")
     parser.add_argument("user_script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.elastic > 0:
+        return run_elastic(args)
 
     # --launcher local always runs on this host, hostfile or not
     multi_node = (os.path.isfile(args.hostfile) or args.force_multi) and args.launcher != "local"

@@ -384,6 +384,55 @@ class CheckpointSectionConfig(ConfigModel):
     save_on_preemption: bool = False
 
 
+class FaultToleranceConfig(ConfigModel):
+    """Elastic training fault tolerance (runtime/heartbeat.py + the elastic
+    agent's liveness monitor + comm/comm.py bounded collectives — the
+    training-side analog of the reference's elastic agent supervision,
+    ``DSElasticAgent`` in deepspeed/elasticity/elastic_agent.py, extended with
+    hang detection the reference delegates to torch-elastic/NCCL timeouts).
+
+    ``heartbeat`` arms per-rank liveness stamps: the engine writes
+    ``step + wall-clock + last-entered-collective`` to
+    ``<heartbeat_dir>/hb.rank<R>.json`` from its existing host-touch points
+    (zero extra device syncs — dslint's host-sync rule scans heartbeat.py),
+    throttled to one write per ``heartbeat_interval_s``.  The elastic agent
+    exports ``DSTPU_HEARTBEAT_DIR`` to its workers, which arms stamping even
+    when this section is absent — config here is for standalone runs that
+    want the liveness file anyway.
+
+    ``collective_timeout_s`` bounds host-level collectives (``comm.barrier``
+    and anything routed through ``comm.bounded_collective``): instead of a
+    silent distributed deadlock, a wedged collective raises
+    ``CollectiveTimeoutError`` naming the collective, this rank, and the
+    elapsed time — a fast, attributable failure the agent restarts from.
+    ``init_retries``/``init_retry_backoff_s`` bound the exponential-backoff
+    retry loop around transient process-group setup failures in
+    ``comm.init_distributed`` (coordinator not yet listening at scale-up);
+    ``deepspeed_tpu.initialize()`` applies them before process-group setup,
+    and the agent-exported env (``DSTPU_INIT_RETRIES`` /
+    ``DSTPU_INIT_RETRY_BACKOFF_S``) wins over both.
+    """
+    heartbeat: bool = False
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = Field(1.0, ge=0.0)
+    collective_timeout_s: Optional[float] = Field(None, gt=0.0)
+    init_retries: int = Field(3, ge=0)
+    init_retry_backoff_s: float = Field(0.5, ge=0.0)
+
+    def model_validate(self):
+        import os
+
+        from .heartbeat import HEARTBEAT_DIR_ENV
+        # the agent-exported env satisfies the requirement (it's the remedy
+        # the error names): heartbeat=true under supervision must not turn
+        # every worker into a restartable config error the agent respawns
+        # until the budget burns
+        if self.heartbeat and not self.heartbeat_dir and not os.environ.get(HEARTBEAT_DIR_ENV):
+            raise ValueError("fault_tolerance.heartbeat=true needs heartbeat_dir "
+                             "(or launch under the elastic agent, which exports "
+                             "DSTPU_HEARTBEAT_DIR and overrides this section)")
+
+
 class ServingResilienceConfig(ConfigModel):
     """Serving-side overload policy for the v2 ragged engine
     (inference/v2/admission.py — the serving analog of the training-side
@@ -578,6 +627,10 @@ class TrainingConfig(ConfigModel):
     # ``get_curriculum_params`` — curriculum_type/min/max/schedule keys)
     curriculum_learning: Optional[Dict[str, Any]] = None
     checkpoint: CheckpointSectionConfig = Field(CheckpointSectionConfig)
+    # training-side liveness + bounded collectives (heartbeat stamps, hang
+    # conversion, process-group setup retries); the elastic agent's env
+    # exports override/augment this section for supervised workers
+    fault_tolerance: FaultToleranceConfig = Field(FaultToleranceConfig)
     nebula: NebulaConfig = Field(NebulaConfig)
     # serving-side resilience thresholds; consumed by inference/v2 (the
     # InferenceConfig carries the same section so a serving-only config and a

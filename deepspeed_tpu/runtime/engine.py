@@ -34,6 +34,7 @@ from . import lr_schedules, optimizers
 from .checkpointing import (CheckpointError, _is_rank0, find_latest_valid_tag,
                             load_checkpoint_dir, save_checkpoint_with_retries,
                             sweep_retention, validate_checkpoint_tag)
+from .heartbeat import build_heartbeat
 from .grad_accum import accumulate_micro_grads
 from .config import TrainingConfig, load_config
 from .optimizers import (LossScaleState, clip_by_global_norm, global_grad_norm, has_overflow, init_loss_scale,
@@ -155,6 +156,15 @@ class Engine:
         self.telemetry = TelemetryCollector(config.telemetry, monitor=self.monitor,
                                             batch_size=self.train_batch_size)
         self._last_telemetry_record = None
+        # per-rank liveness stamps for the elastic agent (runtime/heartbeat.py):
+        # armed by the fault_tolerance config section OR the agent-exported
+        # DSTPU_HEARTBEAT_DIR env; the NULL writer otherwise (no-op stamps)
+        self.heartbeat = build_heartbeat(config.fault_tolerance)
+        # unconditional: this engine's config OWNS the process default, so a
+        # timeout from an earlier engine's config can never leak into a later
+        # engine (None resets to unbounded, the historical behavior)
+        from ..comm import comm as _dist
+        _dist.set_default_collective_timeout(config.fault_tolerance.collective_timeout_s)
         self.throughput = ThroughputTimer(batch_size=self.train_batch_size)
         self.global_steps = 0
         self.global_samples = 0
@@ -690,6 +700,7 @@ class Engine:
             self.global_steps += 1
             self.global_samples += self.train_batch_size
             self.lr_scheduler.last_step = self.global_steps
+            self.heartbeat.stamp(self.global_steps)
             if self.telemetry.enabled:
                 # XLA cost analysis of the streamed layer loop is not one
                 # program; MFU stays null on this path
@@ -755,6 +766,9 @@ class Engine:
         self.global_steps += 1
         self.global_samples += self.train_batch_size
         self.lr_scheduler.last_step = self.global_steps
+        # liveness stamp at the step's existing host-touch point: python-int
+        # step + wall clock only, throttled inside the writer (zero syncs)
+        self.heartbeat.stamp(self.global_steps)
         if telemetry:
             if self.telemetry.wants_flops():
                 self.telemetry.set_flops_per_step(self._train_step_flops(batch))
@@ -1012,6 +1026,9 @@ class Engine:
         state = self.state if self.offload_device is None else self._offload_host_state()
         ck = self.config.checkpoint
         t0 = time.perf_counter()
+        # phase-stamped so the agent's hang dump distinguishes "in checkpoint
+        # IO" (expected to be slow) from "wedged in a collective"
+        self.heartbeat.stamp(self.global_steps, phase="checkpoint_save", force=True)
         with self.telemetry.annotation("checkpoint_save"):
             save_checkpoint_with_retries(
                 save_dir, tag, state, client_state, config=self.config,
@@ -1025,6 +1042,7 @@ class Engine:
         if ck.keep_last_n and _is_rank0():
             sweep_retention(save_dir, ck.keep_last_n, verify_integrity=ck.verify_integrity)
         self._register_preemption_handler(save_dir)
+        self.heartbeat.stamp(self.global_steps, force=True)
         return tag
 
     # ----------------------------------------------- preemption (SIGTERM) save
@@ -1094,9 +1112,18 @@ class Engine:
         incomplete, or corrupt target tag (per manifest sizes, plus CRC32s when
         ``checkpoint.verify_integrity`` is on) doesn't raise: the load walks
         prior tags — checkpoint-index order, newest first — to the newest one
-        that validates (resume-from-latest-valid)."""
+        that validates (resume-from-latest-valid).
+
+        When ``tag`` is None and the elastic agent pinned a consensus resume
+        tag (``DSTPU_RESUME_TAG`` env), that pin wins over ``latest``: every
+        rank of a restarted generation must resume from the SAME tag, not its
+        own per-rank newest (which the failure may have left divergent).  The
+        pin only applies when the pinned tag exists under ``load_dir`` — a
+        load from a directory the consensus wasn't computed over (e.g. a
+        pretrained base checkpoint) still gets its own ``latest``."""
         self._nvme_guard("load_checkpoint")
         t0 = time.perf_counter()
+        self.heartbeat.stamp(self.global_steps, phase="checkpoint_load", force=True)
         with self.telemetry.annotation("checkpoint_load"):
             if self.config.load_universal_checkpoint:
                 out = self._load_universal_checkpoint(load_dir, tag, load_optimizer_states)
@@ -1122,14 +1149,44 @@ class Engine:
                     out = (tag, client_state)
         self.telemetry.record_events([("Train/Checkpoint/load_time_ms",
                                        (time.perf_counter() - t0) * 1e3, self.global_samples)])
+        # trailing marker: clears phase=checkpoint_load (whose 10x IO grace
+        # would delay post-resume hang detection) but declares phase=resumed,
+        # because the jit recompile between here and the first step can
+        # outlast the heartbeat timeout — the agent grants 'resumed' stamps
+        # the startup grace window instead of indicting a healthy restart
+        self.heartbeat.stamp(self.global_steps, phase="resumed", force=True)
         return out
 
     def _resolve_load_tag(self, load_dir: str, tag: Optional[str],
                           fallback_to_valid: bool) -> str:
-        """Pick the tag to load: the requested one (or ``latest``) when it
-        validates; otherwise — only with ``fallback_to_valid`` — the newest
-        prior tag that does."""
+        """Pick the tag to load: the requested one (or the agent-pinned
+        ``DSTPU_RESUME_TAG``, or ``latest``) when it validates; otherwise —
+        only with ``fallback_to_valid`` — the newest prior tag that does."""
         from .checkpointing import get_latest_tag
+        from .heartbeat import RESUME_DIR_ENV, RESUME_TAG_ENV
+        pinned = None
+        if tag is None:
+            pinned = os.environ.get(RESUME_TAG_ENV) or None
+            # the pin is scoped to the agent-supervised checkpoint dir: a
+            # base/warm-start load from an unrelated directory must not have
+            # its 'latest' hijacked.  Tag names are the generic
+            # global_step<N>, so a tag-existence check alone can false-match
+            # a foreign dir — when the agent also exported the dir it
+            # computed consensus over, require load_dir to be under it
+            if pinned is not None and os.path.isdir(os.path.join(load_dir, pinned)):
+                resume_dir = os.environ.get(RESUME_DIR_ENV) or None
+                if resume_dir is not None:
+                    try:
+                        inside = os.path.commonpath(
+                            [os.path.realpath(load_dir), os.path.realpath(resume_dir)]
+                        ) == os.path.realpath(resume_dir)
+                    except ValueError:  # different drives / mixed abs-rel
+                        inside = False
+                    if not inside:
+                        pinned = None
+            else:
+                pinned = None
+            tag = pinned
         verify = self.config.checkpoint.verify_integrity
         requested, failure = tag, None
         try:
@@ -1141,6 +1198,17 @@ class Engine:
             validate_checkpoint_tag(load_dir, requested, verify_integrity=verify)
             return requested
         except CheckpointError as exc:
+            if pinned is not None:
+                # never silently walk away from the agent's consensus pin:
+                # falling back would resume this rank from a DIFFERENT tag
+                # than its peers — the exact divergence the pin prevents.
+                # Fail fast so the agent restarts and re-runs consensus
+                # (enable its verify_checkpoint_integrity to also catch what
+                # this rank's CRC pass caught).
+                raise CheckpointError(
+                    f"agent-pinned resume tag {pinned!r} failed validation on this "
+                    f"rank ({exc}); refusing to fall back to a per-rank tag — all "
+                    f"ranks must resume from the same checkpoint") from exc
             if not fallback_to_valid:
                 raise
             failure = exc

@@ -98,7 +98,13 @@ class HostSyncInHotPath(Rule):
                    "block_until_ready) inside per-step train/eval/serving code; "
                    "under inference/v2/ any direct np.asarray/np.array/"
                    "device_get/block_until_ready outside the sanctioned "
-                   "fastpath.materialize() deferred-sync helper")
+                   "fastpath.materialize() deferred-sync helper; in "
+                   "runtime/heartbeat.py any explicit device fetch "
+                   "(np.asarray/np.array/device_get/block_until_ready/.item) "
+                   "anywhere in the file — liveness stamps are contractually "
+                   "zero-device-sync (float() on host config values stays "
+                   "legal there; float-of-device-value isn't statically "
+                   "separable from it)")
 
     HOT_NAMES = {"train_batch", "_offload_train_batch", "eval_batch",
                  "decode_burst", "train_step"}
@@ -110,6 +116,11 @@ class HostSyncInHotPath(Rule):
     # classic hot-path function names
     V2_PATH_FRAGMENT = "inference/v2/"
     V2_SANCTIONED_FNS = {"materialize"}
+    # the heartbeat seam's contract is ZERO device syncs — stamps are called
+    # from the train hot loop and must only write values the host already
+    # owns, so the WHOLE file is scanned (module level included) with the
+    # full sync set, not just the hot-path function names
+    HEARTBEAT_PATH_FRAGMENT = "runtime/heartbeat.py"
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -121,7 +132,11 @@ class HostSyncInHotPath(Rule):
 
     def check(self, module, ctx):
         jit_roots = ctx.jit_roots(module)
-        in_v2 = self.V2_PATH_FRAGMENT in module.relpath.replace("\\", "/")
+        relpath = module.relpath.replace("\\", "/")
+        if relpath.endswith(self.HEARTBEAT_PATH_FRAGMENT):
+            yield from self._check_heartbeat_file(module, jit_roots)
+            return
+        in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent
         for node in ast.walk(module.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -156,6 +171,26 @@ class HostSyncInHotPath(Rule):
                                        "observable and deferrable; route it through the "
                                        "helper or suppress with a reason if this is "
                                        "host-only data")
+
+    def _check_heartbeat_file(self, module, jit_roots) -> Iterator[Finding]:
+        """Whole-file scan of runtime/heartbeat.py with the full sync set:
+        stamps run inside the train hot loop, so a sync sneaking into ANY
+        helper here becomes a silent per-step stall — flag it everywhere,
+        module level included."""
+        for sub in _walk_skipping(module.tree, set(jit_roots)):
+            if not isinstance(sub, ast.Call):
+                continue
+            # explicit-fetch set + .item(): float() on host config values is
+            # legitimate and pervasive here (same reasoning as the v2 scan)
+            msg = self._v2_sync_call(sub)
+            if msg is None and isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "item":
+                msg = ".item() forces a device value to host"
+            if msg:
+                yield self.finding(module, sub, msg + " in runtime/heartbeat.py "
+                                   "— heartbeat stamps are contractually "
+                                   "zero-device-sync (they run in the train hot "
+                                   "loop); stamp only host-native values")
 
     def _sync_call(self, call: ast.Call) -> Optional[str]:
         f = call.func

@@ -1,0 +1,40 @@
+"""Tolerant environment-variable number parsing.
+
+The elastic agent drives its workers through an env contract
+(``DSTPU_HEARTBEAT_INTERVAL_S``, ``DSTPU_COLLECTIVE_TIMEOUT_S``,
+``DSTPU_INIT_RETRIES``, ...).  Every consumer wants the same semantics: unset
+or empty means "use the default", garbage means "warn once and use the
+default" — a malformed env var must degrade supervision, never crash a
+worker.  One helper so the parse sites can't drift apart.
+"""
+
+from typing import Callable, Optional, TypeVar
+
+from .logging import warning_once
+
+T = TypeVar("T")
+
+
+def _env_number(name: str, default: Optional[T], cast: Callable[[str], T],
+                warn: bool) -> Optional[T]:
+    import os
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        if warn:
+            warning_once(f"env: bad {name}={raw!r} (not a {cast.__name__}); "
+                         f"using default {default!r}")
+        return default
+
+
+def env_float(name: str, default: Optional[float] = None,
+              warn: bool = True) -> Optional[float]:
+    return _env_number(name, default, float, warn)
+
+
+def env_int(name: str, default: Optional[int] = None,
+            warn: bool = True) -> Optional[int]:
+    return _env_number(name, default, int, warn)

@@ -337,6 +337,128 @@ def tracing_smoke():
     return 0
 
 
+def elastic_smoke():
+    """CI smoke for elastic training fault tolerance (ISSUE 7 acceptance):
+    a 4-worker CPU run under the elastic agent with TWO injected faults —
+    kill one rank mid-step in generation 0, then hang another (stamped
+    'entered all_reduce', detectable only by heartbeat staleness) in the next
+    generation — asserting: rescale to elastic-valid worlds, every generation
+    resumed from the agent-pinned consensus tag, exact loss continuity vs an
+    uninterrupted reference run, the hang dump naming the stuck collective,
+    and zero orphaned worker processes."""
+    import os
+    import signal
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    # overall deadline: this smoke TESTS hang detection, so a regression in
+    # it must fail the lane, not wedge CI forever waiting on a poll loop
+    # that never indicts the injected hang
+    def _deadline(signum, frame):
+        raise TimeoutError("elastic_smoke exceeded its 480s deadline — the "
+                           "agent's hang detection may have regressed")
+
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(480)
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    worker_cmd = [sys.executable, "-u", os.path.join(root, "tests", "unit", "elastic_worker.py")]
+    steps = 6
+
+    def worker_env(tmp, faults):
+        env = dict(os.environ, ELASTIC_TMP=tmp, ELASTIC_STEPS=str(steps),
+                   ELASTIC_FAULTS=json.dumps(faults))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    # uninterrupted reference: one rank, no faults, same model/batches — the
+    # continuity oracle (every rank trains the SAME deterministic fp32 MLP)
+    ref_tmp = tempfile.mkdtemp(prefix="dstpu_elastic_ref_")
+    rc = DSElasticAgent(worker_cmd, world_size=1, poll_interval=0.1,
+                        env=worker_env(ref_tmp, [])).run()
+    assert rc == 0, f"reference run failed rc={rc}"
+    ref_loss = {}
+    with open(os.path.join(ref_tmp, "loss.rank0.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            ref_loss[rec["step"]] = rec["loss"]
+    assert sorted(ref_loss) == list(range(1, steps + 1))
+
+    # the faulty run: crash rank 2 in gen 0, hang rank 1 in gen 1.  The crash
+    # awaits global_step1 in EVERY rank dir first, so the post-crash consensus
+    # always has a common tag (cross-rank startup skew would otherwise race
+    # the first saves and legitimately yield a fresh start)
+    tmp = tempfile.mkdtemp(prefix="dstpu_elastic_smoke_")
+    faults = [{"mode": "crash", "rank": 2, "step": 2, "gen": 0,
+               "await_tag": "global_step1"},
+              {"mode": "hang", "rank": 1, "step": 1, "gen": 1}]
+    agent = DSElasticAgent(
+        worker_cmd, world_size=4,
+        elastic_config={"max_train_batch_size": 8, "micro_batch_sizes": [1, 2],
+                        "min_gpus": 1, "max_gpus": 4},
+        max_restarts=3, poll_interval=0.1, env=worker_env(tmp, faults),
+        checkpoint_dir=os.path.join(tmp, "ckpt"), per_rank_checkpoints=True,
+        heartbeat_dir=os.path.join(tmp, "hb"), heartbeat_timeout_s=5.0,
+        heartbeat_interval_s=0.1, startup_grace_s=180.0, term_grace_secs=10.0)
+    rc = agent.run()
+    assert rc == 0, f"elastic run failed rc={rc}: {agent.state_snapshot()}"
+
+    events = agent.recorder.tail()
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e["event"], []).append(e)
+
+    # both failure modes seen, both recovered, worlds rescaled validly
+    assert agent.restart_count == 2, f"expected 2 restarts: {by_kind.keys()}"
+    assert by_kind["worker_failed"][0]["rank"] == 2
+    hang = by_kind["hang_detected"][0]
+    assert hang["ranks"] == [1] and hang["collectives"] == {1: "all_reduce"}
+    assert "blocked in collective 'all_reduce'" in hang["report"]
+    rescales = [(e["from_world"], e["to_world"]) for e in by_kind["rescale"]]
+    assert rescales == [(4, 2), (2, 1)], rescales
+
+    # resume-tag consensus: every rank of each restarted generation loaded
+    # EXACTLY the tag the agent pinned
+    assert agent.resume_tags[0] is None and None not in agent.resume_tags[1:]
+    for gen in (1, 2):
+        world = {1: 2, 2: 1}[gen]
+        seen = set()
+        for rank in range(world):
+            marker = os.path.join(tmp, f"resume.gen{gen}.rank{rank}")
+            if os.path.exists(marker):  # a rank at the target step loads nothing
+                seen.add(open(marker).read().strip())
+        assert seen <= {agent.resume_tags[gen]}, (gen, seen, agent.resume_tags)
+
+    # loss continuity: EVERY step logged by ANY rank in ANY generation —
+    # including steps re-executed after a resume — matches the uninterrupted
+    # reference bit-exactly (fp32 determinism contract of elastic_worker)
+    compared = 0
+    for name in os.listdir(tmp):
+        if not name.startswith("loss.rank"):
+            continue
+        with open(os.path.join(tmp, name)) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                assert rec["loss"] == ref_loss[rec["step"]], (name, rec)
+                compared += 1
+    assert compared >= steps, "loss logs suspiciously empty"
+
+    # zero orphans: every worker pid ever spawned is gone
+    pids = os.listdir(os.path.join(tmp, "pids"))
+    orphans = [p for p in pids if os.path.exists(f"/proc/{p}")]
+    assert not orphans, f"orphaned workers: {orphans}"
+    assert os.path.exists(os.path.join(tmp, f"done.gen2.rank0"))
+
+    signal.alarm(0)
+    print(json.dumps({"elastic_smoke": "ok", "restarts": agent.restart_count,
+                      "rescales": rescales, "resume_tags": agent.resume_tags,
+                      "losses_compared": compared, "workers_spawned": len(pids),
+                      "orphans": 0}))
+    return 0
+
+
 def run_smoke_lane(name: str, flag: str):
     """Run one of the smoke entry points as its own recorded lane (subprocess:
     each smoke pins its own env and must not contaminate the pytest lanes)."""
@@ -408,6 +530,7 @@ def main():
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
+             run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
     with open("TESTS_LANES.json", "w") as fh:
@@ -427,6 +550,8 @@ if __name__ == "__main__":
         sys.exit(serving_fastpath_smoke())
     if "--tracing-smoke" in sys.argv:
         sys.exit(tracing_smoke())
+    if "--elastic-smoke" in sys.argv:
+        sys.exit(elastic_smoke())
     if "--lint" in sys.argv:
         sys.exit(run_lint_lane()["rc"])
     sys.exit(main())

@@ -1,0 +1,181 @@
+"""Fault-injectable worker body for the elastic-agent lanes.
+
+The distributed-recovery counterpart of fault_injection.py: where that
+harness plays a dying filesystem at the checkpoint seam, this one plays a
+dying/hanging/lagging RANK under the elastic agent — a real subprocess per
+rank (the same real-process philosophy as mp_worker.py), each running a
+deterministic fp32 MLP train loop with per-step checkpoints, heartbeat
+stamps, and scripted faults.
+
+Env contract (the agent supplies the first block; the test the second):
+
+  RANK / WORLD_SIZE / DSTPU_ELASTIC_RESTART    — identity + generation
+  DSTPU_HEARTBEAT_DIR / DSTPU_HEARTBEAT_INTERVAL_S — liveness (engine-armed)
+  DSTPU_RESUME_TAG                             — agent-pinned consensus tag
+
+  ELASTIC_TMP     — shared scratch: ckpt/rank<R>/ dirs, loss logs, pid
+                    registry, resume markers
+  ELASTIC_STEPS   — total global steps to reach (exit 0 at the target)
+  ELASTIC_FAULTS  — JSON list of fault specs, each
+                    {"mode": ..., "rank": R, "step": N, "gen": G[, "slow_s": s]}
+
+Fault modes (fire when this worker's rank+generation match; ordering within a
+step is pre → train → mid → save.  crash/hang end the process, so they fire at
+the FIRST executed step >= N — resume-proof: the fault still fires when the
+agent pins a resume tag past N.  corrupt_newest fires at exactly N; pre modes
+apply from N on.  A mid fault may carry ``"await_tag": "<tag>"``: the worker
+blocks (still heartbeat-stamping, so the wait can't read as a hang) until
+that tag is valid in EVERY rank's checkpoint dir before acting — this
+de-races fault ordering against cross-rank startup skew, so consensus
+assertions stay deterministic):
+
+  crash            (mid)  os._exit(13) — SIGKILL-style death: no preemption
+                          save, the step-N checkpoint never lands
+  hang             (mid)  stamp 'entered all_reduce' on the heartbeat, then
+                          sleep forever — the stuck-in-a-collective deadlock;
+                          only heartbeat staleness can see it
+  slow             (pre)  sleep slow_s before every step from N on (straggler)
+  drop_heartbeat   (pre)  stop stamping from step N on — liveness loss with a
+                          healthy process (wedged runtime thread analog)
+  corrupt_newest   (mid)  truncate a leaf of the newest checkpoint tag in
+                          THIS rank's dir (torn save) — the agent's consensus
+                          walk must skip it for the whole group
+
+Determinism contract the lane's loss-continuity assert rests on: every rank
+trains the SAME model (fixed init key) on the SAME per-step batch
+(``random_batch(seed=step)``) in fp32, so any rank's checkpoint at step k
+equals an uninterrupted run's state at step k, and post-resume losses must
+match the uninterrupted reference EXACTLY.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _load_faults():
+    spec = os.environ.get("ELASTIC_FAULTS", "")
+    return json.loads(spec) if spec else []
+
+
+def _matching(faults, rank, gen, step, phase):
+    phases = {"crash": "mid", "hang": "mid", "corrupt_newest": "mid",
+              "slow": "pre", "drop_heartbeat": "pre"}
+    exact = {"corrupt_newest"}  # terminal modes use >=; see module docstring
+    return [f for f in faults
+            if int(f["rank"]) == rank and int(f["gen"]) == gen
+            and phases.get(f["mode"]) == phase
+            and (int(f["step"]) == step if f["mode"] in exact
+                 else int(f["step"]) <= step)]
+
+
+def _await_tag(tmp: str, world: int, tag: str, step: int, timeout_s: float = 120.0) -> None:
+    """Block until ``tag`` is valid in every rank's checkpoint dir (or the
+    timeout passes — then fire anyway rather than deadlock the test).  Keeps
+    stamping the heartbeat so the wait never reads as staleness."""
+    from deepspeed_tpu.runtime.checkpointing import is_valid_tag
+    from deepspeed_tpu.runtime.heartbeat import get_heartbeat
+    dirs = [os.path.join(tmp, "ckpt", f"rank{r}") for r in range(world)]
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if all(is_valid_tag(d, tag) for d in dirs):
+            return
+        get_heartbeat().stamp(step)
+        time.sleep(0.05)
+
+
+def _corrupt_newest_tag(ckpt_dir: str) -> None:
+    from deepspeed_tpu.runtime.checkpointing import list_tags, read_metadata
+    tags = list_tags(ckpt_dir)
+    if not tags:
+        return
+    tag = tags[-1]
+    meta = read_metadata(os.path.join(ckpt_dir, tag))
+    key = meta["manifest"][0]["key"]
+    os.truncate(os.path.join(ckpt_dir, tag, key + ".npy"), 16)
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+    rank = int(os.environ["RANK"])
+    gen = int(os.environ.get("DSTPU_ELASTIC_RESTART", "0"))
+    tmp = os.environ["ELASTIC_TMP"]
+    total_steps = int(os.environ.get("ELASTIC_STEPS", "8"))
+    faults = _load_faults()
+    hidden = 8
+
+    pid_dir = os.path.join(tmp, "pids")
+    os.makedirs(pid_dir, exist_ok=True)
+    with open(os.path.join(pid_dir, str(os.getpid())), "w") as fh:
+        fh.write(f"rank={rank} gen={gen}\n")
+
+    ckpt_dir = os.path.join(tmp, "ckpt", f"rank{rank}")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn,
+        model_parameters=init_mlp_params(jax.random.PRNGKey(0), hidden=hidden),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "bf16": {"enabled": False},  # fp32: exact cross-generation continuity
+            "steps_per_print": 10_000,
+            "checkpoint": {"save_on_preemption": True},  # SIGTERM grace-window save
+        })
+
+    def fire_mid(step: int) -> None:
+        for f in _matching(faults, rank, gen, step, "mid"):
+            if f.get("await_tag"):
+                _await_tag(tmp, int(os.environ["WORLD_SIZE"]), f["await_tag"], step)
+            if f["mode"] == "corrupt_newest":
+                _corrupt_newest_tag(ckpt_dir)
+            elif f["mode"] == "crash":
+                os._exit(13)  # SIGKILL-style: no cleanup, no preemption save
+            elif f["mode"] == "hang":
+                # the stuck-in-a-collective deadlock: stamp the collective
+                # name, then never return — only staleness can detect this
+                from deepspeed_tpu.runtime.heartbeat import get_heartbeat
+                get_heartbeat().enter_collective("all_reduce")
+                while True:
+                    time.sleep(3600)
+
+    pinned = os.environ.get("DSTPU_RESUME_TAG")
+    if pinned:
+        # tag=None on purpose: the ENGINE must honor the agent's pin (this is
+        # the no-code-changes contract real worker scripts rely on)
+        loaded_tag, _ = engine.load_checkpoint(ckpt_dir)
+        assert loaded_tag == pinned, (loaded_tag, pinned)
+        with open(os.path.join(tmp, f"resume.gen{gen}.rank{rank}"), "w") as fh:
+            fh.write(loaded_tag)
+        # terminal faults honor first-step->=N semantics even when the pinned
+        # tag already sits at/past N (the whole run may have progressed while
+        # this rank's previous life was dying): fire at resume, not never
+        fire_mid(max(engine.global_steps, 1))
+
+    loss_log = os.path.join(tmp, f"loss.rank{rank}.jsonl")
+    while engine.global_steps < total_steps:
+        step = engine.global_steps + 1
+        for f in _matching(faults, rank, gen, step, "pre"):
+            if f["mode"] == "slow":
+                time.sleep(float(f.get("slow_s", 0.3)))
+            elif f["mode"] == "drop_heartbeat":
+                engine.heartbeat.enabled = False
+        loss = float(engine.train_batch(random_batch(engine.train_batch_size,
+                                                     hidden=hidden, seed=step)).loss)
+        with open(loss_log, "a") as fh:
+            fh.write(json.dumps({"gen": gen, "rank": rank, "step": step,
+                                 "loss": loss}) + "\n")
+        fire_mid(step)
+        engine.save_checkpoint(ckpt_dir)
+
+    with open(os.path.join(tmp, f"done.gen{gen}.rank{rank}"), "w") as fh:
+        fh.write(f"steps={engine.global_steps}\n")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

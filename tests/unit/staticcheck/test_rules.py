@@ -105,6 +105,41 @@ class TestHostSyncInHotPath:
             """, self.RULE, filename="deepspeed_tpu/inference/v2/engine_v2.py")
         assert out == []
 
+    # ---- runtime/heartbeat.py whole-file scan (elastic fault tolerance):
+    # liveness stamps are contractually zero-device-sync, so ANY explicit
+    # fetch anywhere in the file is a finding — hot-path names or not
+    def test_heartbeat_file_flags_asarray_in_any_function(self):
+        out = run("""
+            import numpy as np
+
+            def stamp_extras(dev):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/runtime/heartbeat.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "zero-device-sync" in out[0].message
+
+    def test_heartbeat_file_flags_item_and_module_level(self):
+        out = run("""
+            import jax
+
+            PROBE = jax.device_get(0)
+
+            class HeartbeatWriter:
+                def stamp(self, step):
+                    return step.item()
+            """, self.RULE, filename="deepspeed_tpu/runtime/heartbeat.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"] * 2
+
+    def test_heartbeat_file_allows_host_float_parsing(self):
+        # float() on config/env values is host math, not a device fetch
+        out = run("""
+            import os
+
+            def interval():
+                return float(os.environ.get("X", "1.0"))
+            """, self.RULE, filename="deepspeed_tpu/runtime/heartbeat.py")
+        assert out == []
+
     def test_same_asarray_outside_v2_stays_clean_in_cold_code(self):
         out = run("""
             import numpy as np

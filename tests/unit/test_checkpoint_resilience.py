@@ -538,3 +538,242 @@ def test_async_engine_roundtrip_with_atomic_protocol(tmp_path):
     p1, p2 = engine.get_fp32_params(), engine2.get_fp32_params()
     for k in p1:
         np.testing.assert_array_equal(p1[k]["w"], p2[k]["w"])
+
+
+# --------------------------------------------------------------------------
+# multi-rank resume-tag consensus (elastic fault tolerance, PR 7): ranks with
+# DIVERGENT newest tags — one torn by the crash that triggered the restart —
+# must converge on the newest tag valid across EVERY rank's directory
+def _two_rank_dirs(tmp_path, steps=3):
+    """Per-rank checkpoint layout (<dir>/rank<R>/) with identical tag history:
+    one engine, every step saved to both rank dirs (the consensus walk only
+    reads tag lists + manifests, not tensor provenance)."""
+    engine = make_engine()
+    dirs = [str(tmp_path / "ck" / f"rank{r}") for r in range(2)]
+    for _ in range(steps):
+        train(engine, 1)
+        for d in dirs:
+            engine.save_checkpoint(d)
+    return engine, dirs
+
+
+def test_consensus_skips_tag_torn_on_one_rank(tmp_path):
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    _, dirs = _two_rank_dirs(tmp_path)
+    newest = list_tags(dirs[0])[-1]
+    # rank1's newest save was interrupted: torn leaf, size check catches it
+    truncate_leaf(os.path.join(dirs[1], newest), "params.layer_0.w")
+    assert is_valid_tag(dirs[0], newest)            # rank0 still thinks newest is fine
+    tag = select_consensus_tag(dirs)
+    assert tag == list_tags(dirs[0])[-2]            # whole group steps back
+    assert tag != newest
+
+
+def test_consensus_with_bitflip_needs_integrity_pass(tmp_path):
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    _, dirs = _two_rank_dirs(tmp_path)
+    newest = list_tags(dirs[0])[-1]
+    corrupt_leaf(os.path.join(dirs[1], newest), "params.layer_0.w")  # size-preserving
+    # size/completeness checks can't see a same-size bitflip...
+    assert select_consensus_tag(dirs) == newest
+    # ...the CRC pass can, and the consensus walk steps the whole group back
+    assert select_consensus_tag(dirs, verify_integrity=True) == list_tags(dirs[0])[-2]
+
+
+def test_consensus_when_one_rank_never_saved_newest(tmp_path):
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    engine, dirs = _two_rank_dirs(tmp_path, steps=2)
+    train(engine, 1)
+    engine.save_checkpoint(dirs[0])  # rank1 died before its step-3 save landed
+    assert len(list_tags(dirs[0])) == 3 and len(list_tags(dirs[1])) == 2
+    assert select_consensus_tag(dirs) == list_tags(dirs[1])[-1]
+
+
+def test_consensus_dropped_metadata_steps_back(tmp_path):
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    _, dirs = _two_rank_dirs(tmp_path)
+    newest = list_tags(dirs[0])[-1]
+    drop_metadata(os.path.join(dirs[1], newest))
+    assert select_consensus_tag(dirs) == list_tags(dirs[0])[-2]
+
+
+def test_consensus_none_when_no_common_valid_tag(tmp_path):
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    engine = make_engine()
+    train(engine, 1)
+    d0 = str(tmp_path / "rank0")
+    engine.save_checkpoint(d0)
+    assert select_consensus_tag([d0, str(tmp_path / "rank1_empty")]) is None
+    assert select_consensus_tag([]) is None
+    assert select_consensus_tag(["", None]) is None
+
+
+def test_agent_resume_pin_matches_fallback_walk(tmp_path):
+    """The agent's consensus choice must equal what a single rank's
+    fallback_to_valid load would pick over the same (damaged) directory —
+    same validation, same walk order."""
+    from deepspeed_tpu.elasticity import select_consensus_tag
+    engine, dirs = _two_rank_dirs(tmp_path)
+    newest = list_tags(dirs[1])[-1]
+    truncate_leaf(os.path.join(dirs[1], newest), "params.layer_0.w")
+    tag = select_consensus_tag(dirs)
+    assert tag == find_latest_valid_tag(dirs[1])
+    engine2 = make_engine()
+    loaded_tag, _ = engine2.load_checkpoint(dirs[1], fallback_to_valid=True)
+    assert loaded_tag == tag
+
+
+@pytest.mark.slow
+def test_agent_consensus_skips_harness_corrupted_tag_end_to_end(tmp_path):
+    """Full loop with the distributed fault-injection harness: rank 1
+    truncates a leaf of its newest tag (torn save) and crashes; the agent's
+    consensus walk must step the WHOLE group past the torn tag, and the next
+    generation (respawned at the same world — min valid size) must resume
+    from it and finish with reference-exact losses."""
+    import subprocess
+    import sys
+
+    from deepspeed_tpu.elasticity import DSElasticAgent
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    worker_cmd = [sys.executable, "-u",
+                  os.path.join(root, "tests", "unit", "elastic_worker.py")]
+    tmp = str(tmp_path)
+    faults = [
+        # order matters: truncate the newest tag (global_step2), THEN die —
+        # both fire on rank 1's step 3, before the step-3 save lands; the
+        # crash awaits global_step1 everywhere so the consensus walk always
+        # has the common tag this test asserts on (startup skew de-raced)
+        {"mode": "corrupt_newest", "rank": 1, "step": 3, "gen": 0},
+        {"mode": "crash", "rank": 1, "step": 3, "gen": 0,
+         "await_tag": "global_step1"},
+    ]
+    env = dict(os.environ, ELASTIC_TMP=tmp, ELASTIC_STEPS="6",
+               ELASTIC_FAULTS=json.dumps(faults))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    agent = DSElasticAgent(
+        worker_cmd, world_size=2,
+        # min valid world == 2: the respawn keeps BOTH ranks, so the consensus
+        # walk must span both checkpoint dirs (incl. the corrupted one)
+        elastic_config={"max_train_batch_size": 8, "micro_batch_sizes": [1, 2],
+                        "min_gpus": 2, "max_gpus": 2},
+        max_restarts=2, poll_interval=0.1, env=env,
+        checkpoint_dir=os.path.join(tmp, "ckpt"), per_rank_checkpoints=True,
+        term_grace_secs=10.0)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    # rank1's dir held gs1 + TORN gs2 at the crash: consensus must land on gs1
+    assert agent.resume_tags[1] == "global_step1"
+    for rank in range(2):
+        marker = os.path.join(tmp, f"resume.gen1.rank{rank}")
+        assert open(marker).read().strip() == "global_step1"
+        assert os.path.exists(os.path.join(tmp, f"done.gen1.rank{rank}"))
+
+
+def test_engine_honors_agent_pinned_resume_tag(tmp_path, monkeypatch):
+    """load_checkpoint(tag=None) resumes from DSTPU_RESUME_TAG when the
+    elastic agent pinned one — 'latest' would point each rank at its own
+    (possibly divergent) newest; an explicit tag argument still wins."""
+    from deepspeed_tpu.runtime.heartbeat import RESUME_TAG_ENV
+
+    engine = make_engine()
+    train(engine, 1)
+    tag1 = engine.save_checkpoint(str(tmp_path))
+    train(engine, 1)
+    tag2 = engine.save_checkpoint(str(tmp_path))
+    assert tag1 != tag2
+
+    monkeypatch.setenv(RESUME_TAG_ENV, tag1)
+    engine2 = make_engine()
+    loaded, _ = engine2.load_checkpoint(str(tmp_path))  # pin beats 'latest'
+    assert loaded == tag1
+    engine3 = make_engine()
+    loaded, _ = engine3.load_checkpoint(str(tmp_path), tag=tag2)  # arg beats pin
+    assert loaded == tag2
+
+    monkeypatch.delenv(RESUME_TAG_ENV)
+    engine4 = make_engine()
+    loaded, _ = engine4.load_checkpoint(str(tmp_path))  # no pin: 'latest'
+    assert loaded == tag2
+
+
+def test_resume_pin_scoped_to_agent_checkpoint_dir(tmp_path, monkeypatch):
+    """The pin only applies where the pinned tag exists: a worker loading a
+    base/warm-start checkpoint from an UNRELATED directory must get that
+    directory's own 'latest', not a hijacked (and there nonexistent) tag."""
+    from deepspeed_tpu.runtime.heartbeat import RESUME_TAG_ENV
+
+    engine = make_engine()
+    train(engine, 1)
+    train_tag = engine.save_checkpoint(str(tmp_path / "train"))  # global_step1
+    train(engine, 1)
+    base_tag = engine.save_checkpoint(str(tmp_path / "base"))    # global_step2
+    assert train_tag != base_tag
+
+    monkeypatch.setenv(RESUME_TAG_ENV, train_tag)
+    engine2 = make_engine()
+    # pinned tag absent from base/: 'latest' there, no CheckpointError
+    loaded, _ = engine2.load_checkpoint(str(tmp_path / "base"))
+    assert loaded == base_tag
+    # ...while the agent-supervised dir still honors the pin
+    engine3 = make_engine()
+    loaded, _ = engine3.load_checkpoint(str(tmp_path / "train"))
+    assert loaded == train_tag
+
+
+def test_resume_pin_dir_scoping_beats_identical_tag_names(tmp_path, monkeypatch):
+    """Tag names are the generic global_step<N>, so an unrelated base dir can
+    hold a tag NAMED like the pin — the agent-exported DSTPU_RESUME_DIR must
+    keep the pin from hijacking that load."""
+    from deepspeed_tpu.runtime.heartbeat import RESUME_DIR_ENV, RESUME_TAG_ENV
+
+    engine = make_engine()
+    train(engine, 1)
+    pin_tag = engine.save_checkpoint(str(tmp_path / "train"))      # global_step1
+    train(engine, 1)
+    engine.save_checkpoint(str(tmp_path / "train"))                # global_step2
+    engine_b = make_engine()
+    train(engine_b, 1)
+    clash = engine_b.save_checkpoint(str(tmp_path / "base"))       # global_step1 too!
+    train(engine_b, 1)
+    base_latest = engine_b.save_checkpoint(str(tmp_path / "base"))  # global_step2
+    assert clash == pin_tag and base_latest != pin_tag
+
+    monkeypatch.setenv(RESUME_TAG_ENV, pin_tag)
+    monkeypatch.setenv(RESUME_DIR_ENV, str(tmp_path / "train"))
+    eng = make_engine()
+    # base/ has an identically-NAMED tag, but it is outside the resume dir:
+    # the warm-start load keeps its own 'latest'
+    loaded, _ = eng.load_checkpoint(str(tmp_path / "base"))
+    assert loaded == base_latest
+    # the supervised dir still honors the pin over its newer 'latest'
+    eng2 = make_engine()
+    loaded, _ = eng2.load_checkpoint(str(tmp_path / "train"))
+    assert loaded == pin_tag
+
+
+def test_pinned_tag_validation_failure_refuses_fallback(tmp_path, monkeypatch):
+    """A rank whose copy of the agent-pinned tag fails validation must FAIL
+    (so the agent restarts and re-runs consensus), never silently fall back
+    to its own per-rank newest valid tag — resuming a different tag than the
+    peers is the exact divergence the pin exists to prevent."""
+    from deepspeed_tpu.runtime.heartbeat import RESUME_TAG_ENV
+    from .fault_injection import truncate_leaf
+
+    engine = make_engine()
+    train(engine, 1)
+    tag1 = engine.save_checkpoint(str(tmp_path))
+    train(engine, 1)
+    tag2 = engine.save_checkpoint(str(tmp_path))
+    truncate_leaf(os.path.join(str(tmp_path), tag2), "params.layer_0.w")
+
+    monkeypatch.setenv(RESUME_TAG_ENV, tag2)
+    engine2 = make_engine()
+    with pytest.raises(CheckpointError, match="pinned resume tag"):
+        engine2.load_checkpoint(str(tmp_path), fallback_to_valid=True)
+    # without a pin the same fallback_to_valid load walks back normally
+    monkeypatch.delenv(RESUME_TAG_ENV)
+    engine3 = make_engine()
+    loaded, _ = engine3.load_checkpoint(str(tmp_path), fallback_to_valid=True)
+    assert loaded == tag1

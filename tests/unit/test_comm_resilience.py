@@ -1,0 +1,206 @@
+"""Bounded-collective tests (elastic fault tolerance, comm/comm.py): a
+silent distributed deadlock becomes a fast, named CollectiveTimeoutError; the
+heartbeat is stamped around the blocking wait so the agent's hang dump can
+name the collective; process-group setup retries transient failures.
+
+Separate from test_comm.py so these run even when the in-graph collective
+tests are blocked by jax API drift (they need no mesh, no shard_map)."""
+
+import json
+import time
+
+import jax
+import pytest
+
+from deepspeed_tpu.comm import (CollectiveTimeoutError, barrier, bounded_collective,
+                                set_default_collective_timeout)
+from deepspeed_tpu.comm import comm as comm_mod
+from deepspeed_tpu.runtime.heartbeat import HeartbeatWriter, heartbeat_path, set_heartbeat
+
+
+def test_bounded_collective_passes_result_and_args():
+    assert bounded_collective(lambda a, b: a + b, 2, b=3, timeout_s=5.0) == 5
+    assert bounded_collective(lambda: "unbounded") == "unbounded"  # no default set
+
+
+def test_bounded_collective_timeout_names_collective_and_rank():
+    with pytest.raises(CollectiveTimeoutError) as err:
+        bounded_collective(lambda: time.sleep(30), timeout_s=0.2, name="all_gather")
+    e = err.value
+    assert e.collective == "all_gather" and e.timeout_s == 0.2
+    assert e.elapsed_s >= 0.2 and e.rank == 0
+    assert "all_gather" in str(e) and "rank 0" in str(e)
+
+
+def test_bounded_collective_propagates_worker_exception():
+    def boom():
+        raise ValueError("mismatched shapes")
+
+    with pytest.raises(ValueError, match="mismatched shapes"):
+        bounded_collective(boom, timeout_s=5.0)
+
+
+def test_bounded_collective_stamps_heartbeat(tmp_path):
+    writer = HeartbeatWriter(str(tmp_path), 0, interval_s=0.0)
+    set_heartbeat(writer)
+    try:
+        seen = {}
+
+        def inside():
+            seen.update(json.load(open(heartbeat_path(str(tmp_path), 0))))
+            return 1
+
+        assert bounded_collective(inside, timeout_s=5.0, name="reduce_scatter") == 1
+        assert seen["collective"] == "reduce_scatter"  # stamped BEFORE blocking
+        after = json.load(open(heartbeat_path(str(tmp_path), 0)))
+        assert after["collective"] is None  # cleared on exit
+    finally:
+        set_heartbeat(None)
+
+
+def test_collective_name_retained_on_timeout(tmp_path):
+    """On timeout the worker thread is STILL wedged inside the collective —
+    the on-disk stamp must keep naming it so the agent's hang dump can
+    attribute the deadlock (a clearing stamp would erase the diagnosis AND
+    reset the staleness clock on a rank making no progress)."""
+    writer = HeartbeatWriter(str(tmp_path), 0, interval_s=0.0)
+    set_heartbeat(writer)
+    try:
+        with pytest.raises(CollectiveTimeoutError):
+            bounded_collective(lambda: time.sleep(30), timeout_s=0.1, name="barrier")
+        after = json.load(open(heartbeat_path(str(tmp_path), 0)))
+        assert after["collective"] == "barrier"
+    finally:
+        set_heartbeat(None)
+
+
+def test_collective_timeout_default_resolution(monkeypatch):
+    monkeypatch.delenv(comm_mod.COLLECTIVE_TIMEOUT_ENV, raising=False)
+    assert comm_mod._resolve_timeout(None) is None
+    set_default_collective_timeout(7.0)
+    try:
+        assert comm_mod._resolve_timeout(None) == 7.0
+        assert comm_mod._resolve_timeout(3.0) == 3.0          # arg wins
+        assert comm_mod._resolve_timeout(0) is None           # 0/negative: unbounded
+        monkeypatch.setenv(comm_mod.COLLECTIVE_TIMEOUT_ENV, "2.5")
+        assert comm_mod._resolve_timeout(None) == 2.5         # env beats module default
+        monkeypatch.setenv(comm_mod.COLLECTIVE_TIMEOUT_ENV, "not_a_float")
+        assert comm_mod._resolve_timeout(None) == 7.0         # bad env falls through
+    finally:
+        set_default_collective_timeout(None)
+
+
+def test_barrier_completes_under_timeout():
+    barrier(timeout_s=30.0)  # single process: returns well inside the bound
+
+
+def test_init_distributed_retries_transient_setup_failures(monkeypatch):
+    attempts = []
+    naps = []
+
+    def flaky_init(**kwargs):
+        attempts.append(kwargs)
+        if len(attempts) < 3:
+            raise RuntimeError("coordinator not listening yet")
+
+    resets = []
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: resets.append(1))
+    monkeypatch.setattr(comm_mod.time, "sleep", lambda s: naps.append(s))
+    monkeypatch.setenv(comm_mod.INIT_RETRIES_ENV, "3")
+    monkeypatch.setenv(comm_mod.INIT_RETRY_BACKOFF_ENV, "0.5")
+    comm_mod._initialize_with_retries("host:1234", 2, 0)
+    assert len(attempts) == 3
+    assert naps == [0.5, 1.0]  # exponential backoff
+    assert attempts[0]["coordinator_address"] == "host:1234"
+    # a failed initialize leaves jax's global distributed state assigned and
+    # the next attempt would raise 'should only be called once' — the loop
+    # must reset between attempts or the retry knobs are dead code
+    assert len(resets) == 2
+
+
+def test_init_distributed_retry_budget_exhausts(monkeypatch):
+    def always_fails(**kwargs):
+        raise RuntimeError("port held by previous generation")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_fails)
+    monkeypatch.setattr(comm_mod.time, "sleep", lambda s: None)
+    monkeypatch.setenv(comm_mod.INIT_RETRIES_ENV, "2")
+    with pytest.raises(RuntimeError, match="port held"):
+        comm_mod._initialize_with_retries("host:1234", 2, 0)
+
+
+def test_init_retry_module_defaults_and_env_precedence(monkeypatch):
+    """set_init_retry_defaults (the config seam) drives the retry loop when
+    the agent exported no env; the env wins when present."""
+    monkeypatch.delenv(comm_mod.INIT_RETRIES_ENV, raising=False)
+    monkeypatch.delenv(comm_mod.INIT_RETRY_BACKOFF_ENV, raising=False)
+    attempts = []
+    naps = []
+
+    def always_fails(**kwargs):
+        attempts.append(kwargs)
+        raise RuntimeError("coordinator down")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_fails)
+    monkeypatch.setattr(comm_mod.time, "sleep", lambda s: naps.append(s))
+    comm_mod.set_init_retry_defaults(1, 0.25)
+    try:
+        with pytest.raises(RuntimeError, match="coordinator down"):
+            comm_mod._initialize_with_retries("host:1234", 2, 0)
+        assert len(attempts) == 2 and naps == [0.25]
+        attempts.clear()
+        monkeypatch.setenv(comm_mod.INIT_RETRIES_ENV, "0")  # agent env beats config
+        with pytest.raises(RuntimeError):
+            comm_mod._initialize_with_retries("host:1234", 2, 0)
+        assert len(attempts) == 1
+    finally:
+        comm_mod.set_init_retry_defaults(3, 0.5)
+
+
+def test_initialize_applies_fault_tolerance_retry_defaults():
+    """deepspeed_tpu.initialize() lands fault_tolerance.init_retries /
+    init_retry_backoff_s in comm BEFORE init_distributed runs — the config
+    knobs must bound the very retry loop the section documents."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        return jnp.mean((batch @ params["w"]) ** 2)
+
+    base = {"train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000}
+    try:
+        deepspeed_tpu.initialize(
+            loss_fn=loss_fn, model_parameters={"w": jnp.ones((4, 2))},
+            config=dict(base, fault_tolerance={"init_retries": 7,
+                                               "init_retry_backoff_s": 0.125}))
+        assert comm_mod._DEFAULT_INIT_RETRIES == 7
+        assert comm_mod._DEFAULT_INIT_RETRY_BACKOFF_S == 0.125
+    finally:
+        comm_mod.set_init_retry_defaults(3, 0.5)
+
+
+def test_engine_config_owns_collective_timeout_default(tmp_path):
+    """Engine construction applies its fault_tolerance.collective_timeout_s to
+    the process default UNCONDITIONALLY — a timeout from one engine's config
+    must not leak into a later engine built without one."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    def loss_fn(params, batch, rng):
+        return jnp.mean((batch @ params["w"]) ** 2)
+
+    base = {"train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "steps_per_print": 1000}
+    deepspeed_tpu.initialize(
+        loss_fn=loss_fn, model_parameters={"w": jnp.ones((4, 2))},
+        config=dict(base, fault_tolerance={"collective_timeout_s": 1.5}))
+    assert comm_mod._resolve_timeout(None) == 1.5
+    deepspeed_tpu.initialize(
+        loss_fn=loss_fn, model_parameters={"w": jnp.ones((4, 2))}, config=dict(base))
+    assert comm_mod._resolve_timeout(None) is None  # reset, not leaked

@@ -152,3 +152,40 @@ def test_sparse_attention_per_mode_defaults():
         "mode": "bigbird", "num_random_blocks": 0, "attention": "unidirectional"}})
     sc = cfg.sparse_attention.build(2)
     assert sc.num_random_blocks == 0 and sc.attention == "unidirectional"
+
+
+def test_fault_tolerance_section_defaults_and_validation():
+    from deepspeed_tpu.runtime.config import FaultToleranceConfig, load_config
+    cfg = load_config({"train_micro_batch_size_per_gpu": 2})
+    ft = cfg.fault_tolerance
+    assert not ft.heartbeat and ft.heartbeat_dir is None
+    assert ft.heartbeat_interval_s == 1.0 and ft.collective_timeout_s is None
+    assert ft.init_retries == 3 and ft.init_retry_backoff_s == 0.5
+
+    cfg = load_config({"train_micro_batch_size_per_gpu": 2,
+                       "fault_tolerance": {"heartbeat": True, "heartbeat_dir": "/tmp/hb",
+                                           "collective_timeout_s": 60.0}})
+    assert cfg.fault_tolerance.heartbeat and cfg.fault_tolerance.collective_timeout_s == 60.0
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="heartbeat_dir"):
+        FaultToleranceConfig(heartbeat=True)  # armed without a directory
+    with _pytest.raises(ValueError):
+        FaultToleranceConfig(collective_timeout_s=0.0)  # gt=0 bound
+
+
+def test_fault_tolerance_heartbeat_satisfied_by_agent_env(monkeypatch):
+    """heartbeat=true with no dir must VALIDATE under the elastic agent —
+    its exported DSTPU_HEARTBEAT_DIR is the very remedy the error names, and
+    raising anyway turns every supervised worker into a restartable config
+    error the agent respawns until the budget burns."""
+    from deepspeed_tpu.runtime.config import FaultToleranceConfig
+    from deepspeed_tpu.runtime.heartbeat import HEARTBEAT_DIR_ENV
+
+    monkeypatch.delenv(HEARTBEAT_DIR_ENV, raising=False)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="heartbeat_dir"):
+        FaultToleranceConfig(heartbeat=True)
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, "/tmp/agent_hb/gen0")
+    ft = FaultToleranceConfig(heartbeat=True)  # agent env satisfies it
+    assert ft.heartbeat and ft.heartbeat_dir is None

@@ -46,3 +46,22 @@ def test_incompatible_world_size_raises():
 def test_disabled_raises():
     with pytest.raises(ValueError):
         compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+# ---------------------------------------------- solver edge cases (PR 7)
+def test_valid_gpus_duplicate_micro_batches_dedupe():
+    # duplicates add nothing: the valid set is a set, sorted once
+    assert get_valid_gpus(24, [2, 2, 3, 3, 2], 1, 100) == get_valid_gpus(24, [2, 3], 1, 100)
+
+
+def test_valid_gpus_min_exceeds_max_is_empty():
+    assert get_valid_gpus(24, [2, 3], 10, 4) == []
+
+
+def test_valid_gpus_no_divisible_micro_batch_is_empty():
+    assert get_valid_gpus(7, [2, 4], 1, 100) == []
+
+
+def test_best_candidates_min_exceeds_max_finds_nothing():
+    batch, valid, _ = get_best_candidates(100, [2, 4], 50, 10)
+    assert batch is None and valid == []

@@ -1,0 +1,245 @@
+"""Heartbeat seam tests (runtime/heartbeat.py): writer stamping/throttling,
+torn-file tolerance, and the reader-side liveness math the elastic agent's
+hang detection rests on.  Clocks are injected — nothing here sleeps."""
+
+import json
+import os
+
+from deepspeed_tpu.runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
+                                             NULL_HEARTBEAT, HeartbeatWriter,
+                                             build_heartbeat, format_hang_report,
+                                             get_heartbeat, heartbeat_path,
+                                             read_heartbeats, set_heartbeat,
+                                             stale_ranks, straggler_ranks)
+
+
+class FakeClocks:
+    """Deterministic wall + monotonic clocks advanced by the test."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def advance(self, dt):
+        self.t += dt
+
+    def clock(self):
+        return self.t
+
+    def monotonic(self):
+        return self.t
+
+
+def make_writer(tmp_path, rank=0, interval=1.0, t=1000.0):
+    clocks = FakeClocks(t)
+    w = HeartbeatWriter(str(tmp_path), rank, interval_s=interval,
+                        clock=clocks.clock, monotonic=clocks.monotonic)
+    return w, clocks
+
+
+# ------------------------------------------------------------------- writer
+def test_stamp_writes_atomic_record(tmp_path):
+    w, clocks = make_writer(tmp_path, rank=3)
+    assert w.stamp(7)
+    record = json.load(open(heartbeat_path(str(tmp_path), 3)))
+    assert record["rank"] == 3 and record["step"] == 7
+    assert record["time"] == clocks.t and record["collective"] is None
+    assert record["pid"] == os.getpid()
+    assert not os.path.exists(heartbeat_path(str(tmp_path), 3) + ".tmp")
+
+
+def test_stamp_throttles_to_interval(tmp_path):
+    w, clocks = make_writer(tmp_path, interval=1.0)
+    assert w.stamp(1)
+    clocks.advance(0.3)
+    assert not w.stamp(2)  # within the interval: no write
+    clocks.advance(0.8)
+    assert w.stamp(3)
+    # the throttled step 2 was still remembered for forced stamps
+    assert json.load(open(heartbeat_path(str(tmp_path), 0)))["step"] == 3
+    assert w.stamps_written == 2
+
+
+def test_force_and_collective_stamps_bypass_throttle(tmp_path):
+    w, clocks = make_writer(tmp_path, interval=100.0)
+    w.stamp(1)
+    w.enter_collective("all_reduce")  # forces despite the 100s interval
+    record = json.load(open(heartbeat_path(str(tmp_path), 0)))
+    assert record["collective"] == "all_reduce"
+    assert record["collective_t"] == clocks.t
+    w.exit_collective()
+    record = json.load(open(heartbeat_path(str(tmp_path), 0)))
+    assert record["collective"] is None
+
+
+def test_close_writes_terminal_phase_then_disables(tmp_path):
+    w, _ = make_writer(tmp_path)
+    w.stamp(5)
+    w.close()
+    assert json.load(open(heartbeat_path(str(tmp_path), 0)))["phase"] == "closed"
+    assert not w.stamp(6)  # closed writers never write again
+
+
+def test_unwritable_dir_degrades_to_disabled_not_raise(tmp_path):
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    w = HeartbeatWriter(str(blocker / "sub"), 0)  # mkdir under a file fails
+    assert not w.enabled
+    assert not w.stamp(1)  # no-op, no exception: supervision degrades, not training
+
+
+def test_failed_stamp_keeps_throttle_cadence(tmp_path):
+    """A write failure advances the throttle: a broken heartbeat dir costs at
+    most one attempt per interval, never a syscall+exception per hot-loop
+    step — and the writer recovers when the dir comes back."""
+    import shutil
+
+    hb_dir = tmp_path / "hb"
+    w, clocks = make_writer(hb_dir, interval=1.0)
+    assert w.stamp(1)
+    shutil.rmtree(hb_dir)  # dir vanishes mid-run (unmounted scratch, ENOSPC...)
+    clocks.advance(1.5)
+    assert not w.stamp(2)  # attempt fails, swallowed
+    os.makedirs(hb_dir)    # dir restored immediately...
+    clocks.advance(0.3)
+    assert not w.stamp(3)  # ...but still inside the interval: no retry storm
+    clocks.advance(1.0)
+    assert w.stamp(4)      # next interval: recovered
+    assert w.enabled
+    # success reset the consecutive-failure count: another outage needs the
+    # full MAX_WRITE_FAILURES again before the writer disables itself
+    shutil.rmtree(hb_dir)
+    for i in range(HeartbeatWriter.MAX_WRITE_FAILURES - 1):
+        clocks.advance(2.0)
+        assert not w.stamp(5 + i)
+    assert w.enabled
+
+
+def test_repeated_stamp_failures_disable_writer(tmp_path):
+    import shutil
+
+    hb_dir = tmp_path / "hb"
+    w, clocks = make_writer(hb_dir, interval=1.0)
+    assert w.stamp(1)
+    shutil.rmtree(hb_dir)
+    for i in range(HeartbeatWriter.MAX_WRITE_FAILURES):
+        clocks.advance(2.0)
+        assert not w.stamp(2 + i)
+    assert not w.enabled  # degraded: supervision off, training unaffected
+    os.makedirs(hb_dir)
+    clocks.advance(2.0)
+    assert not w.stamp(99)  # stays off
+
+
+def test_null_heartbeat_is_inert():
+    assert not NULL_HEARTBEAT.stamp(1)
+    NULL_HEARTBEAT.enter_collective("barrier")
+    NULL_HEARTBEAT.exit_collective()
+    NULL_HEARTBEAT.close()
+    assert not NULL_HEARTBEAT.enabled
+
+
+# ------------------------------------------------------------------- reader
+def test_read_heartbeats_skips_torn_and_foreign_files(tmp_path):
+    w, _ = make_writer(tmp_path, rank=0)
+    w.stamp(4)
+    (tmp_path / "hb.rank1.json").write_text('{"rank": 1, "st')  # torn write
+    (tmp_path / "notes.txt").write_text("not a heartbeat")
+    beats = read_heartbeats(str(tmp_path))
+    assert set(beats) == {0} and beats[0]["step"] == 4
+
+
+def test_read_heartbeats_missing_dir_is_empty(tmp_path):
+    assert read_heartbeats(str(tmp_path / "never_made")) == {}
+
+
+def test_stale_ranks_by_age_and_absence(tmp_path):
+    w0, _ = make_writer(tmp_path, rank=0, t=1000.0)
+    w1, _ = make_writer(tmp_path, rank=1, t=1004.0)
+    w0.stamp(1)
+    w1.stamp(1)
+    beats = read_heartbeats(str(tmp_path))
+    # at t=1007 rank0's stamp is 7s old, rank1's 3s; rank2 never stamped
+    assert stale_ranks(beats, [0, 1, 2], timeout_s=5.0, now=1007.0) == [0, 2]
+    assert stale_ranks(beats, [0, 1], timeout_s=10.0, now=1007.0) == []
+
+
+def test_straggler_ranks_lag_median():
+    beats = {r: {"rank": r, "step": s, "time": 0.0}
+             for r, s in [(0, 50), (1, 49), (2, 51), (3, 30)]}
+    assert straggler_ranks(beats, lag_steps=10) == [3]
+    assert straggler_ranks(beats, lag_steps=25) == []
+    assert straggler_ranks({0: beats[0]}, lag_steps=1) == []  # need >= 2 ranks
+
+
+def test_hang_report_names_stuck_collective_and_diagnosis(tmp_path):
+    w0, _ = make_writer(tmp_path, rank=0, t=1000.0)
+    w1, _ = make_writer(tmp_path, rank=1, t=1000.0)
+    w0.stamp(41)
+    w1.stamp(41)
+    w1.enter_collective("all_reduce")
+    beats = read_heartbeats(str(tmp_path))
+    report = format_hang_report(beats, [0, 1, 2], timeout_s=5.0, now=1030.0)
+    assert "rank 1: STALE" in report
+    assert "blocked in collective 'all_reduce'" in report
+    assert "rank 2: NO HEARTBEAT" in report
+    assert "diagnosis" in report and "all_reduce" in report.split("diagnosis")[1]
+
+
+# ------------------------------------------------------------ build/resolve
+def test_build_heartbeat_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(HEARTBEAT_INTERVAL_ENV, "0.25")
+    monkeypatch.setenv("RANK", "2")
+    monkeypatch.setenv("DSTPU_ELASTIC_RESTART", "3")
+    w = build_heartbeat(None, register_global=False)
+    assert w.enabled and w.rank == 2
+    assert w.interval_s == 0.25 and w.generation == 3
+
+
+def test_build_heartbeat_without_env_or_config_is_null(monkeypatch):
+    monkeypatch.delenv(HEARTBEAT_DIR_ENV, raising=False)
+    assert build_heartbeat(None, register_global=False) is NULL_HEARTBEAT
+
+
+def test_build_heartbeat_config_section(tmp_path, monkeypatch):
+    monkeypatch.delenv(HEARTBEAT_DIR_ENV, raising=False)
+    from deepspeed_tpu.runtime.config import FaultToleranceConfig
+    ft = FaultToleranceConfig(heartbeat=True, heartbeat_dir=str(tmp_path),
+                              heartbeat_interval_s=2.0)
+    w = build_heartbeat(ft, rank=1, register_global=False)
+    assert w.enabled and w.interval_s == 2.0 and w.rank == 1
+
+
+def test_env_dir_overrides_config_dir(tmp_path, monkeypatch):
+    # the agent owns placement: its exported dir wins over the config's
+    env_dir = tmp_path / "agent"
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, str(env_dir))
+    monkeypatch.delenv(HEARTBEAT_INTERVAL_ENV, raising=False)
+    from deepspeed_tpu.runtime.config import FaultToleranceConfig
+    ft = FaultToleranceConfig(heartbeat=True, heartbeat_dir=str(tmp_path / "cfg"))
+    w = build_heartbeat(ft, rank=0, register_global=False)
+    assert w.directory == str(env_dir)
+
+
+def test_build_heartbeat_disabled_resets_global(tmp_path, monkeypatch):
+    """A heartbeat-less engine built after a heartbeat-armed one must not
+    keep stamping the OLD engine's dir through the process-global writer —
+    mirrors the engine's unconditional collective-timeout reset."""
+    monkeypatch.setenv(HEARTBEAT_DIR_ENV, str(tmp_path))
+    w = build_heartbeat(None)
+    assert get_heartbeat() is w and w.enabled
+    monkeypatch.delenv(HEARTBEAT_DIR_ENV)
+    assert build_heartbeat(None) is NULL_HEARTBEAT
+    assert get_heartbeat() is NULL_HEARTBEAT  # no leak into the next engine
+
+
+def test_global_registry_roundtrip(tmp_path):
+    w, _ = make_writer(tmp_path)
+    prev = get_heartbeat()
+    try:
+        set_heartbeat(w)
+        assert get_heartbeat() is w
+        set_heartbeat(None)
+        assert get_heartbeat() is NULL_HEARTBEAT
+    finally:
+        set_heartbeat(prev)

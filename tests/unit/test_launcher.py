@@ -127,3 +127,80 @@ def test_openmpi_interface_flag_optional():
     assert "btl_tcp_if_include" not in cmd
     cmd = OpenMPIRunner(_args(mpi_interface="ens5"), {"h": 1}).get_cmd({}, {"h": 1})
     assert cmd[cmd.index("btl_tcp_if_include") + 1] == "ens5"
+
+
+# ------------------------------------------------------- --elastic wiring
+def test_elastic_flag_routes_to_agent(tmp_path, monkeypatch):
+    """--elastic N builds a DSElasticAgent over the user script (elasticity
+    section from --ds_config, heartbeat knobs from flags) and returns its rc."""
+    import json
+    import sys
+
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps({"elasticity": {"max_train_batch_size": 8,
+                                              "micro_batch_sizes": [2]}}))
+    captured = {}
+
+    class FakeAgent:
+        def __init__(self, cmd, world_size, **kwargs):
+            captured.update(cmd=cmd, world_size=world_size, **kwargs)
+
+        def run(self):
+            return 42
+
+    import deepspeed_tpu.elasticity as elasticity_pkg
+    monkeypatch.setattr(elasticity_pkg, "DSElasticAgent", FakeAgent)
+    rc = runner_mod.main(["--elastic", "4", "--max_restarts", "5",
+                          "--heartbeat_timeout", "3.0", "--ds_config", str(cfg),
+                          "--checkpoint_dir", str(tmp_path / "ck"),
+                          "--collective_timeout", "7.5",
+                          "--verify_checkpoint_integrity", "--per_rank_checkpoints",
+                          "train.py", "--lr", "0.1"])
+    assert rc == 42
+    assert captured["cmd"] == [sys.executable, "-u", "train.py", "--lr", "0.1"]
+    assert captured["world_size"] == 4
+    assert captured["max_restarts"] == 5
+    assert captured["heartbeat_timeout_s"] == 3.0
+    assert captured["collective_timeout_s"] == 7.5
+    assert captured["verify_checkpoint_integrity"] is True
+    assert captured["per_rank_checkpoints"] is True
+    assert captured["heartbeat_dir"]  # agent owns placement (tempdir)
+    assert captured["checkpoint_dir"] == str(tmp_path / "ck")
+    assert captured["elastic_config"] == {"max_train_batch_size": 8,
+                                          "micro_batch_sizes": [2]}
+
+
+def test_elastic_flag_without_heartbeat_timeout_leaves_liveness_off(monkeypatch):
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    captured = {}
+
+    class FakeAgent:
+        def __init__(self, cmd, world_size, **kwargs):
+            captured.update(kwargs)
+
+        def run(self):
+            return 0
+
+    import deepspeed_tpu.elasticity as elasticity_pkg
+    monkeypatch.setattr(elasticity_pkg, "DSElasticAgent", FakeAgent)
+    assert runner_mod.main(["--elastic", "2", "train.py"]) == 0
+    assert "heartbeat_dir" not in captured
+    assert "heartbeat_timeout_s" not in captured
+    assert "collective_timeout_s" not in captured
+
+
+def test_local_launch_path_still_parses_user_script(monkeypatch, tmp_path):
+    # without --elastic the classic single-exec path must still see the
+    # positional user script + args (regression: the elastic flags must not
+    # swallow them)
+    from deepspeed_tpu.launcher import runner as runner_mod
+
+    seen = {}
+    monkeypatch.setattr(runner_mod.subprocess, "call",
+                        lambda cmd: seen.update(cmd=cmd) or 0)
+    assert runner_mod.main(["--hostfile", str(tmp_path / "nope"),
+                            "train.py", "--epochs", "2"]) == 0
+    assert seen["cmd"][-3:] == ["train.py", "--epochs", "2"]
