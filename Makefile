@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke elastic-smoke lint lint-baseline
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke serving-recovery-smoke elastic-smoke lint lint-baseline
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -60,6 +60,14 @@ serving-fastpath-smoke:
 # run; also a lane in run_tests.py
 tracing-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --tracing-smoke
+
+# serving fault tolerance (ISSUE 8): kill a real serving worker mid-decode;
+# supervised restart + journal replay must bring every request to a terminal
+# status with token streams byte-identical to an uninterrupted seeded run,
+# degrade to drain-only past the restart budget, indict a hung worker by
+# heartbeat staleness, and keep the journaling tax under 3% tok/s
+serving-recovery-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --serving-recovery-smoke
 
 # elastic fault tolerance (ISSUE 7): 4 real worker processes under the
 # elastic agent — crash one rank mid-step (gen 0), hang another inside a

@@ -799,7 +799,53 @@ def measure_serving_mixed(on_tpu: bool):
     tokens, dt, lats, hit_stall, link = _run_serving_scenario(eng, prompts, arrivals, max_new)
     if not lats:
         return {"serving_mixed": "no tokens emitted"}
+    # snapshot the SLO percentiles NOW: they must describe exactly the one
+    # timed pass above, not the extra A/B passes the journal block runs
     pct = eng.tracer.percentiles()
+
+    # journaling durability tax (ISSUE 8): the identical scenario on a
+    # journal-armed engine (fsync_every=0, the throughput deploy setting —
+    # fsync_every>=1 buys per-record power-loss durability at one disk
+    # barrier per record and is a deliberate trade, not overhead).  The
+    # request WAL only appends host bytes at wave boundaries, so the tax is
+    # pure host python; <3% on the CPU tiny config is gated by
+    # `make serving-recovery-smoke` with a noise-robust direct measurement,
+    # while this end-to-end A/B number is meaningful on quiet bench hosts.
+    import shutil
+    import tempfile
+
+    journal_dir = tempfile.mkdtemp(prefix="dstpu_bench_journal_")
+    eng_j = InferenceEngineV2(
+        llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+        config={"dtype": "bfloat16" if on_tpu else "float32",
+                "serving_tracing": {"enabled": True},
+                "serving_fault_tolerance": {
+                    "enabled": True, "fsync_every": 0,
+                    "journal_path": os.path.join(journal_dir, "requests.wal")}},
+        num_blocks=num_blocks, block_size=block_size,
+        max_blocks_per_seq=maxb, token_budget=budget,
+        max_seqs_per_step=max_seqs)
+    _run_serving_scenario(eng_j, prompts, arrivals, max_new)  # warm
+    eng_j.tracer.reset_histograms()
+
+    def _best_tok_s(e, passes=3):
+        best = 0.0
+        for _ in range(passes):
+            tk, dtk, lk, _, _ = _run_serving_scenario(e, prompts, arrivals, max_new)
+            if lk and tk:
+                best = max(best, tk / dtk)
+        return best
+
+    # best-of-3 per engine: the scenario is short, so per-pass scheduler
+    # noise dwarfs the journal's host cost — the floor-vs-floor ratio is
+    # the defensible estimate
+    tps_plain, tps_j = _best_tok_s(eng), _best_tok_s(eng_j)
+    journal_overhead_pct = None
+    if tps_plain and tps_j:
+        journal_overhead_pct = round((tps_plain - tps_j) / tps_plain * 100.0, 2)
+    if eng_j.journal is not None:
+        eng_j.journal.close()
+    shutil.rmtree(journal_dir, ignore_errors=True)
     ms = lambda v: round(v * 1e3, 2)
     slo = {}
     for metric in ("ttft", "tbt"):
@@ -823,7 +869,10 @@ def measure_serving_mixed(on_tpu: bool):
             # cost — device->host syncs per emitted token and the fraction of
             # tokens produced inside fused decode bursts
             "serving_mixed_host_syncs_per_tok": round(link["host_syncs"] / max(tokens, 1), 4),
-            "serving_mixed_burst_fraction": round(link["burst_tokens"] / max(tokens, 1), 3)}
+            "serving_mixed_burst_fraction": round(link["burst_tokens"] / max(tokens, 1), 3),
+            # durability tax (ISSUE 8): tok/s with the request journal armed
+            # vs off, same scenario (fsync_every=0; see comment above)
+            "serving_mixed_journal_overhead_pct": journal_overhead_pct}
 
 
 def measure_fsdp_virtual(timeout_s: int = 280):

@@ -6,8 +6,8 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
-from ..runtime.config import (ServingFastpathConfig, ServingResilienceConfig,
-                              ServingTracingConfig)
+from ..runtime.config import (ServingFastpathConfig, ServingFaultToleranceConfig,
+                              ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
@@ -51,6 +51,9 @@ class InferenceConfig(ConfigModel):
     # monitor/tracing.py wired through the v2 serving stack (same section
     # spelling as runtime/config.py so train+serve configs share it)
     serving_tracing: ServingTracingConfig = Field(ServingTracingConfig)
+    # durable request journal + supervised restart / crash recovery —
+    # inference/v2/journal.py + supervisor.py (same dual-spelling contract)
+    serving_fault_tolerance: ServingFaultToleranceConfig = Field(ServingFaultToleranceConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

@@ -1,10 +1,13 @@
 """FastGen-style ragged/continuous-batching serving (reference deepspeed/inference/v2/)."""
-from .admission import (AdmissionQueue, RequestResult, ServingStalledError, ShedReason,
-                        REQUEST_STATUSES)
+from .admission import (AdmissionQueue, RecoveredRequest, RequestResult,
+                        ServingStalledError, ShedReason, REQUEST_STATUSES)
 from .blocked_allocator import BlockedAllocator, KVAllocationError
 from .engine_factory import build_engine, build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .fastpath import PENDING_TOKEN, DeferredTokens, DeviceBatchState, ServeCounters
+from .journal import JournalEntry, JournalState, RequestJournal, replay_journal
 from .ragged_manager import (EmptyPromptError, RaggedStateManager, SequenceDescriptor,
                              UnknownSequenceError)
 from .scheduler import ScheduledChunk, SplitFuseScheduler
+from .supervisor import (RecoveryPlan, ServeSpec, ServingSupervisor,
+                         plan_recovery, recover_and_serve)

@@ -94,6 +94,34 @@ class AdmissionTicket:
     priority: int = 0                  # lower pops first; ties are FIFO
     deadline: Optional[float] = None   # absolute clock() time; None = no TTL
     enqueue_t: float = 0.0
+    # crash-recovery re-admission provenance (ISSUE 8): tokens this request
+    # already emitted in a previous engine life, replayed from the durable
+    # request journal.  The pump admits ``prompt + prefix`` as the sequence's
+    # token history with ``prompt_len`` pinned to the ORIGINAL prompt, so the
+    # recovered decode continues from where it died (the prefix counts as
+    # generated output, not prompt) instead of restarting from scratch.
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    recovered: bool = False
+
+
+@dataclasses.dataclass
+class RecoveredRequest:
+    """One re-admission unit for supervised crash recovery
+    (inference/v2/supervisor.py → ``engine.serve_recovered``): a journaled
+    request plus its already-emitted token prefix and the REMAINING TTL
+    budget (computed on the original wall-clock admit stamp, so a restart
+    never refreshes a deadline).  ``prefix=[]`` re-admits a request that
+    never emitted (or a brand-new one riding the same call)."""
+    uid: int
+    prompt: List[int]
+    prefix: List[int] = dataclasses.field(default_factory=list)
+    priority: int = 0
+    ttl_s: Optional[float] = None      # remaining TTL; None = no deadline
+    pin_ttl: bool = False              # True: ttl_s is authoritative AS-IS
+    # (None = genuinely deadline-free) — a recovered request whose original
+    # life had no TTL must not be handed one by the new engine's
+    # default_ttl_s.  False (new requests): ttl_s=None falls through to the
+    # config default exactly like generate().
 
 
 class AdmissionQueue:
@@ -152,10 +180,22 @@ class AdmissionQueue:
     # --------------------------------------------------------------- intake
     def submit(self, uid: int, prompt: List[int], *, priority: int = 0,
                ttl_s: Optional[float] = None, kv_utilization: Optional[float] = None,
-               token_cap: Optional[int] = None) -> Optional[ShedReason]:
-        """Admit-or-shed.  Returns None on admission, else the ShedReason."""
+               token_cap: Optional[int] = None, prefix: Optional[List[int]] = None,
+               apply_default_ttl: bool = True,
+               recovered: bool = False) -> Optional[ShedReason]:
+        """Admit-or-shed.  Returns None on admission, else the ShedReason.
+
+        ``prefix``/``recovered`` carry crash-recovery provenance (ISSUE 8):
+        the shedding policy sees the FULL token history (prompt + prefix) —
+        a recovered request whose history no longer fits the per-sequence KV
+        cap is a genuine rejection, not an accounting accident.
+        ``apply_default_ttl=False`` pins ``ttl_s`` as authoritative
+        (None = deadline-free) so a re-admission never refreshes or invents
+        a deadline the original request didn't have."""
         self.submitted_total += 1
-        reason = self.shed_reason(len(prompt), kv_utilization=kv_utilization,
+        prefix = list(prefix) if prefix else []
+        reason = self.shed_reason(len(prompt) + len(prefix),
+                                  kv_utilization=kv_utilization,
                                   token_cap=token_cap)
         if reason is not None:
             self.shed_total += 1
@@ -170,12 +210,16 @@ class AdmissionQueue:
                                     detail=reason.detail)
             return reason
         now = self.clock()
-        ttl = ttl_s if ttl_s is not None else self.config.default_ttl_s
+        if ttl_s is not None or not apply_default_ttl:
+            ttl = ttl_s
+        else:
+            ttl = self.config.default_ttl_s
         # `is not None`, not truthiness: an explicit ttl of 0.0 (a spent
         # budget) means "already expired", not "no deadline"
         ticket = AdmissionTicket(uid=int(uid), prompt=list(prompt), priority=int(priority),
                                  deadline=(now + ttl) if ttl is not None else None,
-                                 enqueue_t=now)
+                                 enqueue_t=now, prefix=prefix,
+                                 recovered=bool(recovered))
         heapq.heappush(self._heap, (ticket.priority, self._seq, ticket))
         self._seq += 1
         if self.tracer is not None:
@@ -183,7 +227,8 @@ class AdmissionQueue:
             # was stamped with — tracing adds no clock reads at this seam
             self.tracer.tick(now)
             self.tracer.event("submit", uid=ticket.uid, priority=ticket.priority)
-            self.tracer.on_submit(ticket.uid, now, prompt_len=len(ticket.prompt),
+            self.tracer.on_submit(ticket.uid, now,
+                                  prompt_len=len(ticket.prompt) + len(ticket.prefix),
                                   priority=ticket.priority)
         return None
 
@@ -213,7 +258,8 @@ class AdmissionQueue:
         what is actually waiting to be admitted."""
         if not self._heap:
             return 0, 0
-        return len(self._heap), max(len(e[2].prompt) for e in self._heap)
+        return len(self._heap), max(len(e[2].prompt) + len(e[2].prefix)
+                                    for e in self._heap)
 
     def drain(self) -> List[AdmissionTicket]:
         """Remove and return every queued ticket (stall cleanup), in pop order."""

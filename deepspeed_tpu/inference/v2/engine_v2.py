@@ -11,6 +11,7 @@ ragged kernels.
 """
 
 import inspect
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -21,13 +22,20 @@ from jax.sharding import PartitionSpec
 
 from ...monitor.tracing import RequestTracer
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
+from ...runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
+                                  NULL_HEARTBEAT, SERVING_FSYNC_ENV,
+                                  SERVING_GENERATION_ENV, SERVING_JOURNAL_ENV,
+                                  HeartbeatWriter)
+from ...utils.env import env_float, env_int
 from ...utils.logging import log_dist
 from ..config import DTYPES as _DTYPES, load_inference_config
 from .admission import (DEADLINE_EXPIRED, FAILED, OK, PREEMPT_REQUEUED_EXHAUSTED, SHED,
-                        AdmissionQueue, RequestResult, ServingStalledError)
+                        AdmissionQueue, RecoveredRequest, RequestResult,
+                        ServingStalledError)
 from .blocked_allocator import KVAllocationError
 from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchState,
                        ServeCounters, materialize, round_up_pow2)
+from .journal import RequestJournal, journal_bytes
 from .ragged_manager import RaggedStateManager
 from .scheduler import SplitFuseScheduler
 
@@ -76,7 +84,8 @@ class InferenceEngineV2:
                  max_blocks_per_seq: int = 64, token_budget: int = 256,
                  max_seqs_per_step: int = 32,
                  topology: Optional[MeshTopology] = None,
-                 telemetry=None, clock: Optional[Callable[[], float]] = None):
+                 telemetry=None, clock: Optional[Callable[[], float]] = None,
+                 journal: Optional[RequestJournal] = None):
         self.config = load_inference_config(config)
         self.model = model_module
         self.model_config = model_config
@@ -107,6 +116,44 @@ class InferenceEngineV2:
                                             telemetry=telemetry,
                                             resilience=self.resilience,
                                             tracer=self.tracer)
+        # serving fault tolerance (ISSUE 8): durable request journal + serve-
+        # iteration liveness heartbeat.  Both arm from config OR the
+        # ServingSupervisor's env exports (DSTPU_SERVING_JOURNAL +
+        # DSTPU_HEARTBEAT_DIR), so a supervised worker needs no config
+        # changes — the same contract the elastic training agent uses.  The
+        # env heartbeat dir is honored ONLY under a serving supervisor (the
+        # journal env marks that); a serving engine inside a supervised
+        # TRAINING worker must not clobber the trainer's rank stamps.
+        self.ft = self.config.serving_fault_tolerance
+        generation = int(os.environ.get(SERVING_GENERATION_ENV, "0") or 0)
+        if journal is None:
+            jp = os.environ.get(SERVING_JOURNAL_ENV) or \
+                (self.ft.journal_path if self.ft.enabled else None)
+            if jp:
+                # the supervisor exports its fsync policy alongside the
+                # journal path — without this, a supervised worker's default
+                # config would silently pin strict mode and the operator's
+                # fsync_every choice would be dead in subprocess deployments
+                journal = RequestJournal(
+                    jp, fsync_every=env_int(SERVING_FSYNC_ENV, self.ft.fsync_every),
+                    seed=self.config.seed)
+        self.journal = journal
+        if self.journal is not None:
+            self.journal.open_generation(generation)
+        self._heartbeat = NULL_HEARTBEAT
+        under_supervisor = bool(os.environ.get(SERVING_JOURNAL_ENV))
+        hb_dir = (os.environ.get(HEARTBEAT_DIR_ENV) if under_supervisor else None) \
+            or (self.ft.heartbeat_dir if self.ft.heartbeat else None)
+        if hb_dir:
+            self._heartbeat = HeartbeatWriter(
+                hb_dir, rank=0,
+                interval_s=env_float(HEARTBEAT_INTERVAL_ENV,
+                                     self.ft.heartbeat_interval_s),
+                generation=generation)
+        # recovery counters surfaced by health()/state_snapshot(); the
+        # supervisor stamps restarts_total/degraded onto each engine it builds
+        self.ft_stats = {"restarts_total": 0, "recovered_requests_total": 0,
+                         "degraded": False}
         self.topology = topology
         self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
         self._warn_truncated_nucleus()
@@ -195,6 +242,13 @@ class InferenceEngineV2:
         for uid, prompt in zip(uids, prompts):
             self.manager.add_sequence(int(uid), [int(t) for t in prompt],
                                       deadline=deadline)
+            if self.journal is not None:
+                # step()-level requests journal too (max_new_tokens=0: the
+                # caller's own loop owns the budget) so a crash loses neither
+                # path's requests; recovery re-admission targets the
+                # generate()/serve_recovered contract
+                self.journal.record_admit(int(uid), [int(t) for t in prompt],
+                                          ttl_s=ttl, max_new_tokens=0)
             self.tracer.event("admit", uid=int(uid), direct=True)
             self.tracer.on_admit(int(uid), now, prompt_len=len(prompt))
 
@@ -213,6 +267,10 @@ class InferenceEngineV2:
             status = finish_reason
         else:
             status = OK
+        if self.journal is not None and uid in self.journal.watched:
+            self.journal.record_terminal(
+                uid, status, finish_reason=finish_reason, reason=failure,
+                n_tokens=seq.generated_tokens if seq is not None else 0)
         self.tracer.on_terminal(uid, status, finish_reason=finish_reason,
                                 reason=failure, t=self.tracer.last_now)
 
@@ -395,7 +453,8 @@ class InferenceEngineV2:
         self.counters.step_tokens += len(emits)
         self._emit_serving_gauges(tokens_run=tokens_run)
         return DeferredTokens(toks_dev=toks_dev, emits=emits, row_of=row_of,
-                              counters=self.counters, tracer=self.tracer)
+                              counters=self.counters, tracer=self.tracer,
+                              journal=self.journal)
 
     def _step_reference(self, greedy: bool) -> Dict[int, int]:
         """The pre-fastpath step: full host-side batch rebuild + four uploads
@@ -450,6 +509,8 @@ class InferenceEngineV2:
         self.counters.step_tokens += len(out)
         self.tracer.event("absorb", step=self.scheduler.steps, tokens=len(out))
         self.tracer.on_tokens_map(out)
+        if self.journal is not None:
+            self.journal.note_token_map(out)
         self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
 
@@ -692,6 +753,12 @@ class InferenceEngineV2:
             out[seq.uid] = produced
         self.tracer.event("burst", step=self.scheduler.steps, k=k, seqs=len(live))
         self.tracer.on_burst_tokens({uid: len(toks_) for uid, toks_ in out.items()})
+        if self.journal is not None:
+            # a burst IS a wave boundary: the host just materialized k tokens
+            # per sequence in one sync, so the WAL appends one delta frame
+            # here at zero extra device cost
+            self.journal.note_token_map(out)
+            self.journal.flush()
         # the burst is the dominant emission path: emit the serving gauges
         # here too, so burst-heavy serves surface fresh SLO percentiles and
         # burst-fraction instead of only dispatch-time snapshots
@@ -731,10 +798,44 @@ class InferenceEngineV2:
             return [results[u].tokens for u in uids]
         return [results[u] for u in uids]
 
+    def serve_recovered(self, requests: Sequence[RecoveredRequest], *,
+                        max_new_tokens: int, eos_token_id: Optional[int] = None,
+                        greedy: bool = True, strict: bool = False
+                        ) -> Dict[int, RequestResult]:
+        """Serve a batch where some requests resume a previous engine life
+        (ISSUE 8): each :class:`RecoveredRequest` carries the token prefix it
+        already emitted (replayed from the durable journal) and its REMAINING
+        TTL.  Re-admitted sequences prefill ``prompt + prefix`` in one pass —
+        the KV rebuild — and then continue decoding from where they died; the
+        prefix counts against ``max_new_tokens`` so a recovered request never
+        overruns its original budget.  Entries with an empty prefix are
+        ordinary admissions riding the same call (the supervisor routes new
+        work through here too, so one serve covers a mixed recovery)."""
+        uids = [int(r.uid) for r in requests]
+        prompts = [list(r.prompt) for r in requests]
+        prefixes = {int(r.uid): [int(t) for t in r.prefix]
+                    for r in requests if r.prefix}
+        ttls = {int(r.uid): r.ttl_s for r in requests if r.pin_ttl}
+        priorities = [int(r.priority) for r in requests]
+        self.ft_stats["recovered_requests_total"] += len(prefixes)
+        for r in requests:
+            if r.prefix:
+                self.tracer.event("recovered", uid=int(r.uid),
+                                  prefix=len(r.prefix))
+                self._record_resilience("serving_recovered", uid=int(r.uid),
+                                        prefix_tokens=len(r.prefix))
+        return self._serve(uids, prompts, max_new_tokens=max_new_tokens,
+                           eos_token_id=eos_token_id, greedy=greedy,
+                           strict=strict, priorities=priorities, ttl_s=None,
+                           prefixes=prefixes, ttls=ttls)
+
     def _serve(self, uids: List[int], prompts: Sequence[Sequence[int]], *,
                max_new_tokens: int, eos_token_id: Optional[int], greedy: bool,
                strict: bool, priorities: Optional[Sequence[int]],
-               ttl_s: Optional[float]) -> Dict[int, RequestResult]:
+               ttl_s: Optional[float],
+               prefixes: Optional[Dict[int, List[int]]] = None,
+               ttls: Optional[Dict[int, Optional[float]]] = None
+               ) -> Dict[int, RequestResult]:
         my = set(uids)
         self._reset_table_width_if_idle()
         conflict = sorted(my & set(self.manager.seqs))
@@ -750,23 +851,54 @@ class InferenceEngineV2:
             # over from its previous life must not poison the fresh request
             self.manager.failures.pop(uid, None)
         results: Dict[int, RequestResult] = {}
-        produced = {u: 0 for u in uids}
+        # a recovered prefix pre-spends its share of the max_new_tokens
+        # budget: the request finishes after (budget - prefix) NEW tokens
+        produced = {u: len(prefixes[u]) if prefixes and u in prefixes else 0
+                    for u in uids}
         token_cap = self.manager.max_blocks_per_seq * self.manager.block_size
         try:
             # ---- admission: shed-or-queue BEFORE any KV allocation
             for i, (uid, prompt) in enumerate(zip(uids, prompts)):
+                prefix = prefixes.get(uid, []) if prefixes else []
+                if ttls is not None and uid in ttls:
+                    t, apply_default = ttls[uid], False  # recovery pins the TTL
+                else:
+                    t, apply_default = ttl_s, True
                 shed = self.admission.submit(
-                    uid, [int(t) for t in prompt],
+                    uid, [int(tok) for tok in prompt],
                     priority=priorities[i] if priorities is not None else 0,
-                    ttl_s=ttl_s, kv_utilization=self.manager.kv_utilization(),
-                    token_cap=token_cap)
+                    ttl_s=t, apply_default_ttl=apply_default,
+                    kv_utilization=self.manager.kv_utilization(),
+                    token_cap=token_cap, prefix=prefix or None,
+                    recovered=bool(prefix))
                 if shed is not None:
                     self._record_resilience("serving_shed", uid=uid, code=shed.code,
                                             retryable=shed.retryable, detail=shed.detail)
+                    if self.journal is not None:
+                        # direct write, NOT _journal_terminal: a shed request
+                        # was never admitted so it isn't in `watched` (and a
+                        # recovered request re-shed at re-admission is only in
+                        # a PREVIOUS generation's watched set) — but its
+                        # terminal must still be durable, or replay re-serves
+                        # it forever / reports it unresolved
+                        self.journal.record_terminal(uid, SHED, reason=str(shed),
+                                                     retryable=shed.retryable)
                     if strict:
                         raise RuntimeError(f"request {uid} shed: {shed}")
                     results[uid] = RequestResult(uid=uid, status=SHED, reason=str(shed),
                                                  retryable=shed.retryable)
+                elif self.journal is not None:
+                    # the effective TTL (what admission just stamped) rides
+                    # the admit record, with a wall-clock stamp so recovery
+                    # can keep the ORIGINAL deadline clock across processes
+                    effective = t if t is not None else \
+                        (self.resilience.default_ttl_s if apply_default else None)
+                    self.journal.record_admit(
+                        uid, [int(tok) for tok in prompt],
+                        priority=priorities[i] if priorities is not None else 0,
+                        ttl_s=effective, max_new_tokens=max_new_tokens,
+                        eos_token_id=eos_token_id, greedy=greedy,
+                        prefix_len=len(prefix))
             self._prewarm(max_new_tokens)
             self._serve_loop(uids, my, results, produced, max_new_tokens=max_new_tokens,
                              eos_token_id=eos_token_id, greedy=greedy, strict=strict)
@@ -780,6 +912,10 @@ class InferenceEngineV2:
             # flush the Chrome-trace export (if configured) even on a strict
             # raise — the partial trace is exactly what the postmortem wants
             self.tracer.write_chrome_trace()
+            if self.journal is not None:
+                # buffered token deltas must not outlive the call that
+                # materialized them (a strict raise included)
+                self.journal.flush()
         return results
 
     def _serve_loop(self, uids: List[int], my: set, results: Dict[int, RequestResult],
@@ -803,6 +939,10 @@ class InferenceEngineV2:
 
         while any(u not in results for u in uids):
             self.counters.loop_iterations += 1
+            # serve-iteration liveness stamp (ISSUE 8): phase "serving" on
+            # host-owned ints only — the supervisor reads staleness as a hang.
+            # Throttled inside the writer; NULL writer when supervision is off
+            self._heartbeat.stamp(self.counters.loop_iterations, phase="serving")
             if self._inflight is not None and (len(self.admission)
                                                or self._any_live_deadline()):
                 # wave boundary: admission/deadline handling below may evict
@@ -889,6 +1029,12 @@ class InferenceEngineV2:
                 stall_streak, last_sig = 0, None
                 self._stall_streak = 0
 
+            if self.journal is not None:
+                # wave-boundary WAL flush: every token this iteration
+                # materialized is already host-side, so the delta frame costs
+                # one buffered file append (fsync amortized per fsync_every)
+                self.journal.flush()
+
         if self._inflight is not None:
             # the final absorb resolved every request with a step still in
             # flight (e.g. a coexisting put() sequence rode it): patch its
@@ -933,6 +1079,7 @@ class InferenceEngineV2:
                     raise RuntimeError(f"request {uid} failed: {reason}")
                 self._record_resilience("serving_request_failed", uid=uid,
                                         reason=reason)
+                self._journal_terminal(uid, FAILED, reason=reason)
                 self.tracer.event("failed", step=self.scheduler.steps, uid=uid)
                 self.tracer.on_terminal(uid, FAILED, reason=reason)
                 seq = self.manager.seqs.get(uid)
@@ -964,6 +1111,8 @@ class InferenceEngineV2:
                                              reason="deadline expired while running",
                                              queue_wait_s=seq.queue_wait_s,
                                              preemptions=seq.preemptions)
+                self._journal_terminal(uid, DEADLINE_EXPIRED, retryable=True,
+                                       reason="deadline expired while running")
                 self.tracer.on_terminal(uid, DEADLINE_EXPIRED,
                                         reason="deadline expired while running")
                 self.manager.retire(uid, completed=False)
@@ -979,6 +1128,9 @@ class InferenceEngineV2:
                     tokens=list(seq.tokens), retryable=True,
                     reason=f"preempted {seq.preemptions}x under KV pressure",
                     preemptions=seq.preemptions, queue_wait_s=seq.queue_wait_s)
+                self._journal_terminal(
+                    uid, PREEMPT_REQUEUED_EXHAUSTED, retryable=True,
+                    reason=f"preempted {seq.preemptions}x under KV pressure")
                 self.tracer.on_terminal(
                     uid, PREEMPT_REQUEUED_EXHAUSTED,
                     reason=f"preempted {seq.preemptions}x under KV pressure")
@@ -1080,6 +1232,7 @@ class InferenceEngineV2:
                                      finish_reason=finish_reason,
                                      queue_wait_s=seq.queue_wait_s,
                                      preemptions=seq.preemptions)
+        self._journal_terminal(uid, OK, finish_reason=finish_reason)
         self.tracer.event("finish", step=self.scheduler.steps, uid=uid,
                           reason=finish_reason)
         self.tracer.on_terminal(uid, OK, finish_reason=finish_reason)
@@ -1133,6 +1286,9 @@ class InferenceEngineV2:
                     results[t.uid] = RequestResult(
                         uid=t.uid, status=DEADLINE_EXPIRED, retryable=True,
                         reason="deadline expired in the admission queue")
+                    self._journal_terminal(
+                        t.uid, DEADLINE_EXPIRED, retryable=True,
+                        reason="deadline expired in the admission queue")
                     self.tracer.on_terminal(
                         t.uid, DEADLINE_EXPIRED, t=self.tracer.last_now,
                         reason="deadline expired in the admission queue")
@@ -1145,12 +1301,18 @@ class InferenceEngineV2:
             # queue-wait histogram feeds health() percentiles even with span
             # tracing off: the wait is already computed, pure host arithmetic
             self.tracer.observe_queue_wait(wait)
-            self.manager.add_sequence(ticket.uid, ticket.prompt,
+            # crash recovery: a re-admitted ticket's token history is
+            # prompt + already-emitted prefix (prefilled in one pass — the KV
+            # rebuild), with prompt_len pinned so the prefix keeps counting
+            # as generated output, not prompt
+            self.manager.add_sequence(ticket.uid, ticket.prompt + ticket.prefix,
                                       priority=ticket.priority,
-                                      deadline=ticket.deadline, queue_wait_s=wait)
-            self.tracer.event("admit", step=self.scheduler.steps, uid=ticket.uid)
+                                      deadline=ticket.deadline, queue_wait_s=wait,
+                                      prompt_len=len(ticket.prompt))
+            self.tracer.event("admit", step=self.scheduler.steps, uid=ticket.uid,
+                              **({"recovered": True} if ticket.recovered else {}))
             self.tracer.on_admit(ticket.uid, now, queue_wait_s=wait,
-                                 prompt_len=len(ticket.prompt))
+                                 prompt_len=len(ticket.prompt) + len(ticket.prefix))
         return False
 
     def _handle_stall(self, my: set, results: Dict[int, RequestResult],
@@ -1184,6 +1346,7 @@ class InferenceEngineV2:
                                              tokens=list(seq.tokens), retryable=True,
                                              preemptions=seq.preemptions,
                                              queue_wait_s=seq.queue_wait_s)
+                self._journal_terminal(uid, FAILED, reason=reason, retryable=True)
                 self.tracer.on_terminal(uid, FAILED, reason=reason,
                                         t=self.tracer.last_now)
                 self.manager.retire(uid, completed=False)
@@ -1192,6 +1355,8 @@ class InferenceEngineV2:
                 results[ticket.uid] = RequestResult(uid=ticket.uid, status=FAILED,
                                                     reason=reason + " (still queued)",
                                                     retryable=True)
+                self._journal_terminal(ticket.uid, FAILED, retryable=True,
+                                       reason=reason + " (still queued)")
                 self.tracer.on_terminal(ticket.uid, FAILED, t=self.tracer.last_now,
                                         reason=reason + " (still queued)")
 
@@ -1203,6 +1368,25 @@ class InferenceEngineV2:
     def _record_resilience(self, event: str, **fields) -> None:
         if self.telemetry is not None:
             self.telemetry.record_resilience(event, step=self.scheduler.steps, **fields)
+
+    def _journal_terminal(self, uid: int, status: str, *,
+                          finish_reason: Optional[str] = None,
+                          reason: Optional[str] = None,
+                          retryable: bool = False) -> None:
+        """Mirror a ``RequestResult`` construction into the durable journal
+        (only for uids this journal admitted — foreign put() traffic keeps
+        its own lifecycle).  Terminal records order after their buffered
+        token deltas; strict mode writes + fsyncs them eagerly, throughput
+        mode lands them at the next wave flush (a one-iteration window —
+        a crash inside it re-serves the finished request from its
+        journaled prefix)."""
+        j = self.journal
+        if j is None or uid not in j.watched:
+            return
+        seq = self.manager.seqs.get(uid)
+        j.record_terminal(uid, status, finish_reason=finish_reason, reason=reason,
+                          retryable=retryable,
+                          n_tokens=seq.generated_tokens if seq is not None else 0)
 
     # ------------------------------------------------------------ introspection
     def state_snapshot(self) -> Dict[str, Any]:
@@ -1222,9 +1406,23 @@ class InferenceEngineV2:
             "num_blocks": alloc.num_blocks,
             "queue_depth": len(self.admission),
             "scheduler_steps": self.scheduler.steps,
+            # recovery state (ISSUE 8): restart/recovery counters + journal
+            # size, so a crash postmortem's snapshot shows the durability side
+            "fault_tolerance": self._fault_tolerance_snapshot(),
             # the event history that LED here (ISSUE 6): the always-on flight
             # recorder's tail rides every stall dump for postmortems
             "flight_recorder": self.tracer.recorder.tail(),
+        }
+
+    def _fault_tolerance_snapshot(self) -> Dict[str, Any]:
+        return {
+            **{k: self.ft_stats[k] for k in ("restarts_total",
+                                             "recovered_requests_total",
+                                             "degraded")},
+            "journal_bytes": journal_bytes(self.journal.path
+                                           if self.journal is not None else None),
+            "journaling": self.journal is not None and self.journal.enabled,
+            "heartbeat": bool(getattr(self._heartbeat, "enabled", False)),
         }
 
     def health(self) -> Dict[str, Any]:
@@ -1256,6 +1454,10 @@ class InferenceEngineV2:
             "queue_wait": self.tracer.queue_wait.snapshot(),
             "latency": self.tracer.latency_snapshot(),
             "tracing_enabled": self.tracer.enabled,
+            # crash-durability counters (ISSUE 8): supervised restarts,
+            # requests recovered with an emitted prefix, journal size on
+            # disk, and the drain-only degradation flag
+            "fault_tolerance": self._fault_tolerance_snapshot(),
             # the recent engine-event history (always on, bounded ring)
             "flight_recorder": self.tracer.recorder.tail(32),
         }

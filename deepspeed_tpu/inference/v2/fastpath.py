@@ -106,12 +106,19 @@ class DeferredTokens:
     per-request TTFT/TBT marks belong (ISSUE 6).  Reported once even though
     patch() itself is idempotent (the burst path pre-patches the in-flight
     handle and the serve loop settles it again).
+
+    ``journal`` (inference/v2/journal.py RequestJournal): the same
+    host-visibility moment is where emitted tokens enter the durable request
+    WAL's buffer (ISSUE 8) — tokens the journal never saw die with a crash
+    and are regenerated identically from the journaled prefix, so buffering
+    at this seam adds zero device syncs and zero extra fetches.
     """
     toks_dev: object
     emits: List[Tuple[int, int, int]]
     row_of: Dict[int, int]
     counters: Optional[ServeCounters] = None
     tracer: Optional[object] = None
+    journal: Optional[object] = None
     _cached: Optional[np.ndarray] = None
     _trace_reported: bool = False
 
@@ -140,10 +147,14 @@ class DeferredTokens:
             if pos < len(seq.tokens) and seq.tokens[pos] == PENDING_TOKEN:
                 seq.tokens[pos] = tok
             out[uid] = tok
-        if self.tracer is not None and not self._trace_reported:
+        if not self._trace_reported and (self.tracer is not None
+                                         or self.journal is not None):
             self._trace_reported = True  # patch() is idempotent; marks are not
-            self.tracer.event("absorb", tokens=len(out))
-            self.tracer.on_tokens_map(out)
+            if self.tracer is not None:
+                self.tracer.event("absorb", tokens=len(out))
+                self.tracer.on_tokens_map(out)
+            if self.journal is not None:
+                self.journal.note_token_map(out)
         return out
 
     def drop_emit(self, uid: int) -> None:

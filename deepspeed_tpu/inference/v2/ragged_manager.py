@@ -102,13 +102,24 @@ class RaggedStateManager:
 
     def add_sequence(self, uid: int, prompt_tokens: List[int], *, priority: int = 0,
                      deadline: Optional[float] = None,
-                     queue_wait_s: float = 0.0) -> SequenceDescriptor:
+                     queue_wait_s: float = 0.0,
+                     prompt_len: Optional[int] = None) -> SequenceDescriptor:
+        """``prompt_len`` pins where prompt ends and generated output begins
+        when it differs from ``len(prompt_tokens)`` — crash recovery re-admits
+        ``prompt + already-emitted-prefix`` as the token history (the prefill
+        rebuilds their KV in one pass) while the prefix keeps counting as
+        GENERATED tokens for budgets, results, and gauges."""
         if uid in self.seqs:
             raise ValueError(f"uid {uid} already tracked")
         if not prompt_tokens:
             raise EmptyPromptError(uid)
+        if prompt_len is None:
+            prompt_len = len(prompt_tokens)
+        elif not 0 < prompt_len <= len(prompt_tokens):
+            raise ValueError(f"uid {uid}: prompt_len={prompt_len} outside "
+                             f"(0, {len(prompt_tokens)}]")
         seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens),
-                                 prompt_len=len(prompt_tokens), arrival=self._arrivals,
+                                 prompt_len=int(prompt_len), arrival=self._arrivals,
                                  priority=priority, deadline=deadline,
                                  queue_wait_s=queue_wait_s)
         self._arrivals += 1

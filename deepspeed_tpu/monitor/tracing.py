@@ -165,6 +165,16 @@ class FlightRecorder:
     wedge, not just the wedged state.  Events are stamped with whatever clock
     value the engine last read anyway (see :meth:`RequestTracer.tick`), so an
     always-on recorder costs zero extra clock reads.
+
+    Besides the serve-loop events (dispatch/absorb/flush/burst/preempt/
+    shed/admit/expire/finish/failed/stall), the serving fault-tolerance
+    layer (ISSUE 8) lands its lifecycle here too: ``restart`` (a supervised
+    engine rebuild), ``recovered`` (a request re-admitted with its emitted
+    prefix), and ``finalized`` (a terminal the recovery planner wrote
+    without re-serving) — so a crash postmortem reads as one ring.  The
+    ``ServingSupervisor`` additionally keeps its own instance for the
+    process-level view (generation_spawned/worker_failed/hang_detected/
+    degraded/run_complete).
     """
 
     def __init__(self, capacity: int = 256):

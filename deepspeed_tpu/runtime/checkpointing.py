@@ -32,7 +32,6 @@ import os
 import re
 import shutil
 import time
-import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -40,6 +39,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..utils.logging import log_dist, logger
+from ..utils.wal import atomic_write_text, file_crc32, fsync_dir, fsync_file
 from .checkpoint_engine import CheckpointEngine, NativeCheckpointEngine
 
 LATEST_FILE = "latest"
@@ -80,46 +80,14 @@ def _is_rank0() -> bool:
 
 
 # ------------------------------------------------------------ durable-IO utils
-def _fsync_file(path: str) -> None:
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
-
-
-def _fsync_dir(path: str) -> None:
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return  # fs without directory fds (or non-POSIX); rename is still atomic
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
-
-
-def _atomic_write_text(path: str, text: str) -> None:
-    """Stage + fsync + rename so readers never observe a partial file."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write(text)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path) or ".")
-
-
-def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
-    crc = 0
-    with open(path, "rb") as fh:
-        while True:
-            block = fh.read(chunk)
-            if not block:
-                return crc
-            crc = zlib.crc32(block, crc)
+# One implementation shared with the serving request journal (PR 8): the
+# fsync/CRC/atomic-write idioms live in utils/wal.py; the private names are
+# kept as aliases because this module grew them first and tests/forks import
+# them from here.
+_fsync_file = fsync_file
+_fsync_dir = fsync_dir
+_atomic_write_text = atomic_write_text
+_file_crc32 = file_crc32
 
 
 # staging dirs of saves currently in flight in THIS process: a reentrant save

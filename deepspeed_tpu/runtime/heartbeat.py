@@ -61,6 +61,15 @@ RESUME_DIR_ENV = "DSTPU_RESUME_DIR"
 COLLECTIVE_TIMEOUT_ENV = "DSTPU_COLLECTIVE_TIMEOUT_S"
 INIT_RETRIES_ENV = "DSTPU_INIT_RETRIES"
 INIT_RETRY_BACKOFF_ENV = "DSTPU_INIT_RETRY_BACKOFF_S"
+# ServingSupervisor -> serving-worker contract (inference/v2/supervisor.py):
+# the durable request-journal path, the generation ordinal of the current
+# restart, and the drain-only flag the supervisor raises once the restart
+# budget is exhausted (workers shed new admissions and only finish journaled
+# work).  Same placement rationale as the training contract above.
+SERVING_JOURNAL_ENV = "DSTPU_SERVING_JOURNAL"
+SERVING_FSYNC_ENV = "DSTPU_SERVING_FSYNC_EVERY"
+SERVING_GENERATION_ENV = "DSTPU_SERVING_GENERATION"
+SERVING_DRAIN_ENV = "DSTPU_SERVING_DRAIN"
 _FILE_PREFIX = "hb.rank"
 
 

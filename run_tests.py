@@ -459,6 +459,225 @@ def elastic_smoke():
     return 0
 
 
+def _timed_pass(eng, prompts, max_new_tokens: int = 16) -> float:
+    t0 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=max_new_tokens)
+    return time.perf_counter() - t0
+
+
+def _journal_stream_cost(path: str, prompts, emitted, tok_frames: int,
+                         iterations: int = 300) -> float:
+    """Directly time one serve pass's worth of journal work: the admits,
+    the OBSERVED number of wave-boundary token flushes (each carrying its
+    share of the emitted tokens — fused bursts batch many tokens into one
+    frame), and the terminals — i.e. the record stream the journaled serve
+    of this workload actually appended."""
+    from deepspeed_tpu.inference.v2 import RequestJournal
+    journal = RequestJournal(path, fsync_every=0)
+    waves = max(tok_frames, 1)
+
+    def one_pass():
+        for uid, prompt in enumerate(prompts):
+            journal.record_admit(uid, prompt, max_new_tokens=16)
+        for w in range(waves):
+            for uid, toks in enumerate(emitted):
+                share = toks[w * len(toks) // waves:(w + 1) * len(toks) // waves]
+                if share:
+                    journal.note_tokens(uid, share)
+            journal.flush()
+        for uid, toks in enumerate(emitted):
+            journal.record_terminal(uid, "ok", finish_reason="max_new_tokens",
+                                    n_tokens=len(toks))
+
+    one_pass()
+    # min over many small rounds: the journal's work is deterministic, so
+    # its true cost is the floor — a CI load spike during one timing window
+    # must not masquerade as journal cost
+    cost = float("inf")
+    rounds, per_round = 15, max(iterations // 15, 10)
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(per_round):
+            one_pass()
+        cost = min(cost, (time.perf_counter() - t0) / per_round)
+    journal.close()
+    return cost
+
+
+def serving_recovery_smoke():
+    """CI smoke for serving fault tolerance (ISSUE 8 acceptance): (a) kill a
+    real serving worker mid-decode (fault-injected at journal-flush wave 2);
+    after supervised restart + journal replay — through a torn journal tail
+    left at the restart boundary — every request reaches a terminal
+    ``RequestResult``, recovered token streams are byte-identical to an
+    uninterrupted seeded run, and zero worker processes are orphaned;
+    (b) restart-budget exhaustion degrades to drain-only mode with every
+    journaled request finalized as a structured ``failed`` (no hang);
+    (c) a hung worker (stamps once, then silence) is indicted by heartbeat
+    staleness, not by luck; (d) the journaling durability tax stays under
+    3% tok/s on the CPU tiny-config bench scenario."""
+    import os
+    import signal
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RequestJournal,
+                                            ServingSupervisor)
+    from deepspeed_tpu.models import llama
+    from tests.unit.inference.serving_crash_worker import workload
+
+    def _deadline(signum, frame):
+        raise TimeoutError("serving_recovery_smoke exceeded its 600s deadline — "
+                           "supervised restart or hang detection may have "
+                           "regressed into a wedge")
+
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(600)
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    worker_cmd = [sys.executable, "-u",
+                  os.path.join(root, "tests", "unit", "inference",
+                               "serving_crash_worker.py")]
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    prompts = workload()
+
+    # uninterrupted seeded reference: the token-identity oracle
+    ref = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"}, **kw)
+    ref_out = ref.generate(prompts, max_new_tokens=8)
+
+    # ---- (a) crash mid-decode at gen 0 + torn journal tail at gen-1 startup
+    tmp = tempfile.mkdtemp(prefix="dstpu_serving_recovery_")
+    faults = [{"mode": "crash", "gen": 0, "flush_n": 2},
+              {"mode": "torn_tail", "gen": 1}]
+    env = {"SERVING_TMP": tmp, "SERVING_FAULTS": json.dumps(faults),
+           "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    sup = ServingSupervisor(
+        journal_path=os.path.join(tmp, "requests.wal"),
+        config={"max_restarts": 3, "hang_timeout_s": 60.0,
+                "startup_grace_s": 300.0, "poll_interval_s": 0.1,
+                "heartbeat_interval_s": 0.1})
+    report = sup.supervise_command(worker_cmd, env=env,
+                                   heartbeat_base=os.path.join(tmp, "hb"))
+    assert report["restarts"] == 1, report
+    assert not report["degraded"]
+    state = report["state"]
+    assert not state.incomplete(), [e.uid for e in state.incomplete()]
+    results = report["results"]
+    assert set(results) == set(range(len(prompts))), sorted(results)
+    for uid, r in sorted(results.items()):
+        assert r.status == "ok", (uid, r.status, r.reason)
+        assert r.tokens == ref_out[uid], \
+            f"uid {uid}: recovered stream diverged from the uninterrupted run"
+    recovered = [e for e in state.entries.values()
+                 if e.admits > 1 and e.prefix_len > 0]
+    assert recovered, "no request was actually recovered with an emitted prefix"
+    pids = os.listdir(os.path.join(tmp, "pids"))
+    orphans = [p for p in pids if os.path.exists(f"/proc/{p}")]
+    assert not orphans, f"orphaned serving workers: {orphans}"
+    assert len(pids) == 2, f"expected gen0+gen1 workers, saw {len(pids)}"
+
+    # ---- (b) restart-budget exhaustion: drain-only degradation, no hang
+    tmp2 = tempfile.mkdtemp(prefix="dstpu_serving_budget_")
+    jp2 = os.path.join(tmp2, "requests.wal")
+    seed_journal = RequestJournal(jp2)
+    seed_journal.record_admit(0, [1, 2, 3], max_new_tokens=8)
+    seed_journal.note_tokens(0, [5])
+    seed_journal.flush()
+    seed_journal.close()
+    sup2 = ServingSupervisor(
+        journal_path=jp2,
+        config={"max_restarts": 1, "hang_timeout_s": 5.0,
+                "startup_grace_s": 30.0, "poll_interval_s": 0.02})
+    rep2 = sup2.supervise_command([sys.executable, "-c", "import sys; sys.exit(3)"],
+                                  heartbeat_base=os.path.join(tmp2, "hb"))
+    assert rep2["degraded"], rep2
+    assert not rep2["state"].incomplete()
+    r0 = rep2["results"][0]
+    assert r0.status == "failed" and r0.retryable, r0
+    ev2 = [e["event"] for e in sup2.recorder.tail()]
+    assert "degraded" in ev2 and "finalized" in ev2, ev2
+
+    # ---- (c) hang detection: one stamp, then silence -> heartbeat staleness
+    tmp3 = tempfile.mkdtemp(prefix="dstpu_serving_hang_")
+    hang_script = (
+        "import json,os,time; d=os.environ['DSTPU_HEARTBEAT_DIR'];"
+        "os.makedirs(d, exist_ok=True);"
+        "open(os.path.join(d,'hb.rank0.json'),'w').write("
+        "json.dumps({'rank':0,'time':time.time(),'step':1}));"
+        "time.sleep(600)")
+    sup3 = ServingSupervisor(
+        journal_path=os.path.join(tmp3, "requests.wal"),
+        config={"max_restarts": 0, "hang_timeout_s": 1.0,
+                "startup_grace_s": 30.0, "poll_interval_s": 0.05})
+    rep3 = sup3.supervise_command([sys.executable, "-c", hang_script],
+                                  heartbeat_base=os.path.join(tmp3, "hb"))
+    ev3 = [e["event"] for e in sup3.recorder.tail()]
+    assert "hang_detected" in ev3, ev3
+    assert rep3["degraded"] and rep3["generations"] == 2, rep3
+
+    # ---- (d) journaling durability tax < 3% tok/s (CPU tiny-config bench),
+    # at fsync_every=0 (buffered appends — the throughput deploy setting;
+    # fsync_every>=1 buys per-record durability at the price of one disk
+    # barrier per record, by design).  Two-part gate, both deterministic:
+    #   1. device-side cost is ZERO — the fastpath ServeCounters of a
+    #      journaled serve are byte-identical to an unjournaled one (the
+    #      journal only appends host bytes; it never adds a sync, dispatch,
+    #      upload, or compile), and the tokens match;
+    #   2. the journal's host cost — its ACTUAL record stream for this
+    #      workload, timed directly (min over rounds of a tight loop, so a
+    #      CI load spike can't masquerade as journal cost) — stays under 3%
+    #      of the TYPICAL serve pass (median over 9 passes).
+    # An end-to-end wall-clock A/B delta is deliberately NOT the meter: two
+    # IDENTICAL engines measure ±10% apart under CI load, an order of
+    # magnitude above the journal's true cost; bench.py reports the
+    # end-to-end serving_mixed_journal_overhead_pct on quiet bench hosts.
+    on = InferenceEngineV2(
+        llama, cfg, params,
+        config={"dtype": "float32",
+                "serving_fault_tolerance": {
+                    "enabled": True, "fsync_every": 0,
+                    "journal_path": os.path.join(tmp, "bench.wal")}}, **kw)
+    off = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32"}, **kw)
+    records_before = on.journal.records_written
+    out_on = on.generate(prompts, max_new_tokens=16)
+    pass_records = on.journal.records_written - records_before
+    out_off = off.generate(prompts, max_new_tokens=16)
+    assert out_on == out_off, "journaling changed the served tokens"
+    assert on.counters.snapshot() == off.counters.snapshot(), \
+        f"journaling disturbed the host-link counters: " \
+        f"{on.counters.snapshot()} vs {off.counters.snapshot()}"
+
+    import statistics
+    serve_typical = statistics.median(
+        _timed_pass(on, prompts) for _ in range(9))
+    emitted = [o[len(p):] for o, p in zip(out_on, prompts)]
+    # the observed pass = admits + terminals + its tok frames
+    tok_frames = max(pass_records - 2 * len(prompts), 1)
+    journal_cost = _journal_stream_cost(os.path.join(tmp, "stream.wal"),
+                                        prompts, emitted, tok_frames)
+    overhead_pct = journal_cost / serve_typical * 100.0
+    assert overhead_pct < 3.0, \
+        f"journaling host cost {journal_cost*1e6:.0f}us/pass is " \
+        f"{overhead_pct:.2f}% of the {serve_typical*1e3:.1f}ms typical serve (>= 3%)"
+
+    signal.alarm(0)
+    print(json.dumps({"serving_recovery_smoke": "ok",
+                      "requests": len(prompts),
+                      "restarts": report["restarts"],
+                      "recovered_with_prefix": len(recovered),
+                      "budget_degraded": rep2["degraded"],
+                      "hang_detected": "hang_detected" in ev3,
+                      "journal_overhead_pct": round(overhead_pct, 2),
+                      "orphans": 0}))
+    return 0
+
+
 def run_smoke_lane(name: str, flag: str):
     """Run one of the smoke entry points as its own recorded lane (subprocess:
     each smoke pins its own env and must not contaminate the pytest lanes)."""
@@ -530,6 +749,7 @@ def main():
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
+             run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
@@ -550,6 +770,8 @@ if __name__ == "__main__":
         sys.exit(serving_fastpath_smoke())
     if "--tracing-smoke" in sys.argv:
         sys.exit(tracing_smoke())
+    if "--serving-recovery-smoke" in sys.argv:
+        sys.exit(serving_recovery_smoke())
     if "--elastic-smoke" in sys.argv:
         sys.exit(elastic_smoke())
     if "--lint" in sys.argv:

@@ -1,0 +1,150 @@
+"""Fault-injectable serving worker for the ServingSupervisor lanes.
+
+The serving counterpart of elastic_worker.py: a real subprocess running the
+v2 ragged engine with journaling + heartbeats armed ENTIRELY by the
+supervisor-exported environment (the no-code-changes contract —
+``DSTPU_SERVING_JOURNAL`` arms the WAL, ``DSTPU_HEARTBEAT_DIR`` the
+serve-iteration stamps), serving a deterministic seeded workload with
+scripted faults.
+
+Env contract (the supervisor supplies the first block; the test the second):
+
+  DSTPU_SERVING_JOURNAL / DSTPU_SERVING_GENERATION   — WAL path + generation
+  DSTPU_HEARTBEAT_DIR / DSTPU_HEARTBEAT_INTERVAL_S   — liveness (engine-armed)
+  DSTPU_SERVING_DRAIN                                — drain-only mode flag
+
+  SERVING_TMP     — scratch: pid registry (orphan check), per-gen markers
+  SERVING_FAULTS  — JSON list of fault specs, each
+                    {"mode": ..., "gen": G[, "flush_n": N]}
+
+Fault modes (fire when this worker's generation matches):
+
+  crash          os._exit(13) at the N-th journal flush WRITE of this
+                 generation — a SIGKILL-style death mid-decode: tokens
+                 journaled up to flush N survive, everything later dies with
+                 the process and must be regenerated identically on recovery
+  hang           at the N-th flush write: stop heartbeat stamping, then
+                 sleep forever — liveness loss with a live process; only
+                 heartbeat staleness can see it
+  torn_tail      at STARTUP: append garbage bytes to the journal (the tail a
+                 previous life's crashed writer left mid-frame) — replay
+                 must truncate at the last valid frame and still recover
+  corrupt_frame  at STARTUP: flip one byte inside the LAST frame's payload —
+                 CRC catches it, the frame (and only the unreachable tail)
+                 is dropped, recovery continues from the surviving prefix
+
+Determinism contract the lane's token-identity assert rests on: the workload
+(prompts, uids, budget) derives from a fixed seed identical to the smoke's
+uninterrupted reference run, decode is greedy, and recovery re-admits the
+journaled prefix — so every recovered request's full token stream must equal
+the reference stream exactly.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _load_faults():
+    spec = os.environ.get("SERVING_FAULTS", "")
+    return json.loads(spec) if spec else []
+
+
+def workload(n_requests: int = 6, vocab: int = 128):
+    """The seeded workload shared with the smoke's reference run."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    return [rng.integers(1, vocab, int(n)).tolist()
+            for n in rng.integers(4, 16, n_requests)]
+
+
+def _damage_journal(path: str, mode: str) -> None:
+    """Startup-time journal damage: what a dying writer leaves behind."""
+    if mode == "torn_tail":
+        with open(path, "ab") as fh:
+            fh.write(b"DSWL\x42\x00\x00")  # header fragment, payload never landed
+    elif mode == "corrupt_frame":
+        from deepspeed_tpu.utils.wal import HEADER_SIZE, iter_frames
+        with open(path, "rb") as fh:
+            data = fh.read()
+        last_start, last_end = None, None
+        off = 0
+        for _, end in iter_frames(data):
+            last_start, last_end = off, end
+            off = end
+        if last_start is None:
+            return
+        flip = last_start + HEADER_SIZE  # first payload byte of the last frame
+        damaged = data[:flip] + bytes([data[flip] ^ 0xFF]) + data[flip + 1:]
+        with open(path, "wb") as fh:
+            fh.write(damaged)
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, ServeSpec, recover_and_serve
+    from deepspeed_tpu.models import llama
+
+    gen = int(os.environ.get("DSTPU_SERVING_GENERATION", "0") or 0)
+    tmp = os.environ["SERVING_TMP"]
+    journal_path = os.environ["DSTPU_SERVING_JOURNAL"]
+    faults = [f for f in _load_faults() if int(f["gen"]) == gen]
+
+    pid_dir = os.path.join(tmp, "pids")
+    os.makedirs(pid_dir, exist_ok=True)
+    with open(os.path.join(pid_dir, str(os.getpid())), "w") as fh:
+        fh.write(f"gen={gen}\n")
+
+    # startup damage BEFORE the engine opens the journal, so its first append
+    # (and replay) exercises the torn-tail truncation path
+    for f in faults:
+        if f["mode"] in ("torn_tail", "corrupt_frame") and os.path.exists(journal_path):
+            _damage_journal(journal_path, f["mode"])
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    # journal + heartbeat arm from the supervisor's env — no config needed
+    engine = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                               num_blocks=64, block_size=8, max_blocks_per_seq=8,
+                               token_budget=32, max_seqs_per_step=8)
+    assert engine.journal is not None, "env did not arm the journal"
+
+    terminal = [f for f in faults if f["mode"] in ("crash", "hang")]
+    if terminal:
+        fault = terminal[0]
+        fire_at = int(fault.get("flush_n", 1))
+        count = [0]
+        real_flush = engine.journal.flush
+
+        def flush_with_fault():
+            wrote = real_flush()
+            if wrote:
+                count[0] += 1
+                if count[0] >= fire_at:
+                    if fault["mode"] == "crash":
+                        os._exit(13)  # SIGKILL-style: no cleanup, no close
+                    # hang: stamps stop, the process lives — only heartbeat
+                    # staleness can indict this
+                    engine._heartbeat.enabled = False
+                    while True:
+                        time.sleep(3600)
+            return wrote
+
+        engine.journal.flush = flush_with_fault
+
+    prompts = workload()
+    specs = [ServeSpec(uid=i, prompt=p) for i, p in enumerate(prompts)]
+    results = recover_and_serve(engine, specs, max_new_tokens=8, greedy=True)
+    engine.journal.close()
+
+    with open(os.path.join(tmp, f"done.gen{gen}"), "w") as fh:
+        fh.write(json.dumps({uid: r.status for uid, r in sorted(results.items())}))
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,120 @@
+"""utils/wal.py tests: the CRC-framed WAL primitives shared by the
+checkpoint layer (PR 2) and the serving request journal (PR 8) — frame
+round-trips, torn-tail truncation, bit-flip rejection, and the
+checkpointing aliases staying bound to the single implementation."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.utils import wal
+
+
+def _write(path, payloads):
+    with open(path, "ab") as fh:
+        for p in payloads:
+            wal.append_frame(fh, p)
+
+
+# ------------------------------------------------------------------- frames
+def test_frame_roundtrip(tmp_path):
+    path = str(tmp_path / "log.wal")
+    payloads = [b'{"a":1}', b"", b"\x00\x01binary\xff", b"x" * 4096]
+    _write(path, payloads)
+    got, good, tail = wal.scan_frames(path)
+    assert got == payloads
+    assert tail is None
+    assert good == os.path.getsize(path)
+
+
+def test_missing_file_reads_empty():
+    got, good, tail = wal.scan_frames("/nonexistent/definitely/not.wal")
+    assert got == [] and good == 0 and tail is None
+
+
+def test_torn_header_tail_detected_and_truncated(tmp_path):
+    path = str(tmp_path / "log.wal")
+    _write(path, [b"one", b"two"])
+    clean_size = os.path.getsize(path)
+    with open(path, "ab") as fh:
+        fh.write(wal.FRAME_MAGIC + b"\x07")  # header fragment
+    got, good, tail = wal.scan_frames(path)
+    assert got == [b"one", b"two"] and good == clean_size
+    assert tail is not None and "torn header" in tail
+    assert wal.truncate_torn_tail(path) is not None
+    assert os.path.getsize(path) == clean_size
+    assert wal.truncate_torn_tail(path) is None  # already clean: no-op
+
+
+def test_torn_payload_tail(tmp_path):
+    path = str(tmp_path / "log.wal")
+    _write(path, [b"one"])
+    clean_size = os.path.getsize(path)
+    frame = wal.encode_frame(b"payload-that-gets-cut")
+    with open(path, "ab") as fh:
+        fh.write(frame[:-5])  # payload never fully landed
+    got, good, tail = wal.scan_frames(path)
+    assert got == [b"one"] and good == clean_size
+    assert "torn or corrupt frame" in tail
+
+
+def test_bit_flip_invalidates_frame_and_tail(tmp_path):
+    path = str(tmp_path / "log.wal")
+    _write(path, [b"first", b"second", b"third"])
+    data = open(path, "rb").read()
+    # flip one payload byte of the SECOND frame: CRC must reject it, and the
+    # third frame becomes unreachable (no reliable resync past a bad frame)
+    second_start = len(wal.encode_frame(b"first"))
+    flip = second_start + wal.HEADER_SIZE
+    damaged = data[:flip] + bytes([data[flip] ^ 0x01]) + data[flip + 1:]
+    with open(path, "wb") as fh:
+        fh.write(damaged)
+    got, good, tail = wal.scan_frames(path)
+    assert got == [b"first"]
+    assert good == second_start
+    assert tail is not None
+
+
+def test_append_after_truncation_extends_clean_prefix(tmp_path):
+    path = str(tmp_path / "log.wal")
+    _write(path, [b"keep"])
+    with open(path, "ab") as fh:
+        fh.write(b"garbage-not-a-frame")
+    wal.truncate_torn_tail(path)
+    _write(path, [b"appended"])
+    got, _, tail = wal.scan_frames(path)
+    assert got == [b"keep", b"appended"] and tail is None
+
+
+def test_foreign_bytes_reported_as_bad_magic(tmp_path):
+    path = str(tmp_path / "log.wal")
+    with open(path, "wb") as fh:
+        fh.write(b"this was never a WAL file at all")
+    got, good, tail = wal.scan_frames(path)
+    assert got == [] and good == 0 and "bad magic" in tail
+
+
+# ------------------------------------------------------------ durable-IO kit
+def test_atomic_write_text_replaces_whole_file(tmp_path):
+    path = str(tmp_path / "latest")
+    wal.atomic_write_text(path, "tag_a")
+    wal.atomic_write_text(path, "tag_b")
+    assert open(path).read() == "tag_b"
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_file_crc32_matches_zlib(tmp_path):
+    import zlib
+    path = str(tmp_path / "blob")
+    data = os.urandom(3000)
+    with open(path, "wb") as fh:
+        fh.write(data)
+    assert wal.file_crc32(path, chunk=512) == zlib.crc32(data)
+
+
+def test_checkpointing_aliases_are_the_shared_implementation():
+    from deepspeed_tpu.runtime import checkpointing as ckpt
+    assert ckpt._fsync_file is wal.fsync_file
+    assert ckpt._fsync_dir is wal.fsync_dir
+    assert ckpt._atomic_write_text is wal.atomic_write_text
+    assert ckpt._file_crc32 is wal.file_crc32
