@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..compat import shard_map
 from ..parallel.mesh import MeshTopology, get_topology
 from . import comm
 
@@ -98,8 +99,8 @@ def collective_bandwidth(op: str = "all_gather",
             return lax.fori_loop(0, iters, step, x)
 
         shard_fn = jax.jit(
-            jax.shard_map(looped, mesh=mesh, in_specs=in_spec, out_specs=in_spec,
-                          check_vma=False))
+            shard_map(looped, mesh=mesh, in_specs=in_spec, out_specs=in_spec,
+                      check_vma=False))
         x = jax.device_put(jnp.zeros((elems,), dtype), NamedSharding(mesh, in_spec))
         _sync(shard_fn(x))  # compile + settle
         t0 = time.perf_counter()
@@ -107,8 +108,8 @@ def collective_bandwidth(op: str = "all_gather",
         dt = (time.perf_counter() - t0) / iters
     else:
         shard_fn = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                          check_vma=False))
+            shard_map(body, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                      check_vma=False))
         x = jax.device_put(jnp.zeros((elems,), dtype),
                            NamedSharding(mesh, in_spec))
         dt = _time_op(shard_fn, x, iters)

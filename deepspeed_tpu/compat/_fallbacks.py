@@ -1,0 +1,57 @@
+"""Fallback implementations for shimmed symbols with no old-jax spelling.
+
+Most drifted symbols are pure renames (``TPUCompilerParams`` →
+``CompilerParams``) and resolve to whichever attribute the installed jax
+ships.  A few NEW symbols have no importable pre-drift equivalent at all —
+for those, ``SHIMMED_SYMBOLS`` lists this module as the last candidate, so
+resolution degrades to a behavior-compatible reimplementation instead of an
+ImportError.  Keep each fallback tiny and written against the OLD jax only
+(the new jax never reaches it: its native spelling resolves first).
+"""
+
+from jax import lax
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` for pre-0.6 jax: the canonical ``psum(1, axis)``
+    idiom — constant-folds to a static int under shard_map, so callers can
+    keep using the result in shapes/reshapes."""
+    return lax.psum(1, axis_name)
+
+
+class _SpaceMeta(type):
+    """Lazy members: resolving a memory kind queries the backend's devices,
+    which must not happen at import time (tests pin JAX_PLATFORMS after
+    import; eager resolution would initialize the wrong backend)."""
+
+    _cache = {}
+
+    def _kind(cls, want, fallback_to_default):
+        key = (want, fallback_to_default)
+        if key not in cls._cache:
+            import jax
+            dev = jax.local_devices()[0]
+            kinds = {m.kind for m in dev.addressable_memories()}
+            kind = want if want in kinds else dev.default_memory().kind
+            from jax._src.sharding_impls import TransferToMemoryKind
+            cls._cache[key] = TransferToMemoryKind(kind)
+        return cls._cache[key]
+
+    @property
+    def Host(cls):
+        return cls._kind("pinned_host", True)
+
+    @property
+    def Device(cls):
+        return cls._kind("device", True)
+
+
+class Space(metaclass=_SpaceMeta):
+    """``jax.memory.Space`` for pre-memories-API jax: ``Host``/``Device``
+    resolve to ``TransferToMemoryKind`` placements — legal as ``device_put``
+    targets INSIDE jit only (old jax's restriction), which is exactly where
+    activation offload runs (the engine's train step is jitted; an eager
+    ``device_put(x, Space.Host)`` raises on old jax).  On backends with a
+    single memory space (CPU: only ``unpinned_host``) both members resolve to
+    the same kind, so offload degrades to a pass-through copy with identical
+    math."""

@@ -17,6 +17,9 @@ from typing import Optional, Tuple
 _COLUMN_PAT = re.compile(
     r"(wq|wk|wv|w_gate|w_up|w_fc1|q_proj|k_proj|v_proj|gate_proj|up_proj|query|key|value|"
     r"c_attn|fc_in|wi|lm_head)$")
+# kv-projection subset of the column set: GQA/MQA kv (output narrower than the
+# model dim) replicates instead — models.transformer.kv_projection_shardable
+_KV_PAT = re.compile(r"(wk|wv|k_proj|v_proj|key|value)$")
 # input-dim-sharded (row-parallel)
 _ROW_PAT = re.compile(r"(wo|w_down|w_fc2|o_proj|down_proj|dense|c_proj|fc_out|wo_out)$")
 
@@ -32,6 +35,9 @@ def infer_rule(path: str, shape: Tuple[int, ...]) -> Optional[int]:
     leaf = path.split(".")[-1]
     base = len(shape) - 2  # index of the 'in' dim
     if _COLUMN_PAT.search(leaf):
+        if _KV_PAT.search(leaf):
+            from ..models.transformer import kv_projection_shardable
+            return base + 1 if kv_projection_shardable(shape) else None
         return base + 1
     if _ROW_PAT.search(leaf):
         return base

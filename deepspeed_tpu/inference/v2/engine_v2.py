@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from ...compat import shard_map
 from ...monitor.tracing import RequestTracer
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
@@ -214,7 +215,6 @@ class InferenceEngineV2:
     def _shard_mapped(self, inner, out_specs):
         """Wrap a (params, kv, *replicated) forward for TP: replicated
         activations in, sharded params/KV, psums inside via tp_axis."""
-        from jax import shard_map
         n_rep = len(inspect.signature(inner).parameters) - 2
         rep = tuple(PartitionSpec() for _ in range(n_rep))
         return shard_map(inner, mesh=self.topology.mesh,

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .transformer import (apply_rotary, attention_block, cross_entropy_loss, init_linear,
-                          paged_chunk_indices, rms_norm, rotary_tables, sdpa, swiglu_mlp)
+                          kv_projection_shardable, paged_chunk_indices, rms_norm,
+                          rotary_tables, sdpa, swiglu_mlp)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,14 +159,35 @@ def tp_rules(path: str, shape) -> "int | None":
     Row-parallel (shard input dim): wo, w_down.  Stacked layer leaves carry a
     leading L dim, so dims shift by one.
     """
-    if path.endswith(("attn.wq", "attn.wk", "attn.wv", "mlp.w_gate", "mlp.w_up")):
+    if path.endswith(("attn.wq", "mlp.w_gate", "mlp.w_up")):
         return 2  # [L, in, out] -> shard out
+    if path.endswith(("attn.wk", "attn.wv")):
+        # GQA/MQA kv projections replicate (see kv_projection_shardable)
+        return 2 if kv_projection_shardable(shape) else None
     if path.endswith(("attn.wo", "mlp.w_down")):
         return 1  # [L, in, out] -> shard in
     if path == "lm_head":
         return 1  # [D, V] -> vocab-parallel logits
     return None
 
+
+def make_tp_rules(config: LlamaConfig):
+    """Config-aware v2 serving rules (inference/v2/tp.resolve_rules prefers
+    these over the static ``tp_rules``): GQA kv projections shard
+    head-aligned here — the v2 engine validates ``num_kv_heads % tp == 0``
+    before sharding — while MQA (one kv head) REPLICATES, honoring
+    validate_model's make_tp_rules escape hatch (same contract as falcon).
+    The static rules keep GQA kv replicated instead: GSPMD auto layouts can
+    be asked for sub-head kv shards (tp > kv_heads), which is both the wrong
+    layout and an XLA miscompile (transformer.kv_projection_shardable)."""
+    kv = config.num_kv_heads
+
+    def rules(path: str, shape) -> "int | None":
+        if path.endswith(("attn.wk", "attn.wv")):
+            return 2 if kv > 1 else None
+        return tp_rules(path, shape)
+
+    return rules
 
 def num_params(config: LlamaConfig) -> int:
     D, F, L, V = config.hidden_size, config.intermediate_size, config.num_layers, config.vocab_size

@@ -60,6 +60,7 @@ init_params = llama.init_params
 num_params = llama.num_params
 flops_per_token = llama.flops_per_token
 tp_rules = llama.tp_rules
+make_tp_rules = llama.make_tp_rules
 abstract_params = llama.abstract_params
 from_hf_state_dict = llama.from_hf_state_dict
 hf_streaming_loader = llama.hf_streaming_loader

@@ -207,8 +207,12 @@ def tp_rules(path: str, shape) -> "int | None":
     inference/v2/model_implementations/sharding/ + mixtral container): attention
     column/row split like llama; experts sharded on the intermediate dim
     (w1/w3 column, w2 row per expert); router gate replicated."""
-    if path.endswith(("attn.wq", "attn.wk", "attn.wv")):
+    if path.endswith("attn.wq"):
         return 2  # [L, in, out] -> shard out (heads)
+    if path.endswith(("attn.wk", "attn.wv")):
+        # GQA kv projections replicate (transformer.kv_projection_shardable)
+        from .transformer import kv_projection_shardable
+        return 2 if kv_projection_shardable(shape) else None
     if path.endswith("attn.wo"):
         return 1
     if path.endswith(("experts.w_gate", "experts.w_up")):
@@ -219,6 +223,20 @@ def tp_rules(path: str, shape) -> "int | None":
         return 1  # vocab-parallel logits
     return None
 
+
+def make_tp_rules(config: MixtralConfig):
+    """v2 serving rules: GQA kv shards head-aligned (the v2 engine validates
+    kv % tp == 0 first), MQA replicates (validate_model's make_tp_rules
+    contract); static tp_rules keep GQA kv replicated for GSPMD layouts
+    (transformer.kv_projection_shardable)."""
+    kv = config.num_kv_heads
+
+    def rules(path: str, shape) -> "int | None":
+        if path.endswith(("attn.wk", "attn.wv")):
+            return 2 if kv > 1 else None
+        return tp_rules(path, shape)
+
+    return rules
 
 def forward_paged(config: MixtralConfig, params, tokens, n_tokens, start_pos, block_tables,
                   kv_cache, *, block_size: int, tp_axis: Optional[str] = None,

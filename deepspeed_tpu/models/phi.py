@@ -140,8 +140,11 @@ def tp_rules(path: str, shape) -> "int | None":
     if path.endswith(("bq", "bk", "bv", "b_fc1")):
         return 1
     # bias checks precede weights: "b_fc1"/"b_fc2" suffix-match "fc1"/"fc2"
-    if path.endswith(("wq", "wk", "wv", "fc1")):
+    if path.endswith(("wq", "fc1")):
         return 2
+    if path.endswith(("wk", "wv")):
+        from .transformer import kv_projection_shardable
+        return 2 if kv_projection_shardable(shape) else None
     if path.endswith(("wo", "fc2")):
         return 1
     if path == "lm_head":

@@ -104,10 +104,34 @@ init_paged_cache = llama.init_paged_cache
 def tp_rules(path: str, shape) -> "int | None":
     """Llama's column/row layout + qwen's qkv biases sharded with their
     column-parallel weights ([L, out] -> dim 1)."""
-    if path.endswith(("attn.bq", "attn.bk", "attn.bv")):
+    if path.endswith("attn.bq"):
         return 1
+    if path.endswith(("attn.bk", "attn.bv")):
+        # kv biases must follow their weights: the static rules replicate GQA
+        # kv projections (transformer.kv_projection_shardable — a bias's
+        # [L, out] shape can't even distinguish GQA), so a sharded bias here
+        # would hint the sub-head kv layout the weight rule exists to prevent;
+        # make_tp_rules restores head-aligned sharding where config is known
+        return None
     return llama.tp_rules(path, shape)
 
+
+def make_tp_rules(config: QwenConfig):
+    """v2 serving rules: GQA kv (weights AND their biases) shards
+    head-aligned (the v2 engine validates kv % tp == 0 first), MQA
+    replicates (validate_model's make_tp_rules contract); static tp_rules
+    keep GQA kv replicated for GSPMD layouts
+    (transformer.kv_projection_shardable)."""
+    kv = config.num_kv_heads
+
+    def rules(path: str, shape) -> "int | None":
+        if path.endswith(("attn.wk", "attn.wv")):
+            return 2 if kv > 1 else None
+        if path.endswith(("attn.bk", "attn.bv")):
+            return 1 if kv > 1 else None
+        return tp_rules(path, shape)
+
+    return rules
 
 def forward_paged(config: QwenConfig, params, tokens, n_tokens, start_pos, block_tables,
                   kv_cache, *, block_size: int, tp_axis: Optional[str] = None,

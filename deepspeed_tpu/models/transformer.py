@@ -70,6 +70,23 @@ def apply_rotary(x, cos, sin, positions=None):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def kv_projection_shardable(shape) -> bool:
+    """Whether a kv projection weight ([..., in, out]) may be column-sharded
+    over the tensor axis.
+
+    GQA/MQA kv projections (output narrower than the model dim) stay
+    REPLICATED — the Megatron/AutoTP convention when kv heads don't divide
+    over tp.  Beyond being the right layout (a kv projection is small, and a
+    sub-head shard forces an allgather at every attention), sub-head-aligned
+    kv sharding silently MISCOMPILES in older XLA SPMD partitioners:
+    ``lax.scan`` + the rotate-half rotary on a sub-head-sharded operand
+    returns wrong numerics (no error — ~90% of logits off).  tp_rules can't
+    see head_dim, so "narrower than the input dim" is the conservative
+    stand-in that exactly captures GQA/MQA while leaving MHA layouts (out ==
+    in, head-aligned whenever q-sharding is) untouched."""
+    return len(shape) >= 2 and shape[-1] >= shape[-2]
+
+
 # ----------------------------------------------------------------- attention
 def sdpa(q, k, v, causal=True, mask=None, softmax_scale=None, bias=None):
     """Scaled dot-product attention. q,k,v: [B, S, H, D] (k/v may have fewer

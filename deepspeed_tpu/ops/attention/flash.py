@@ -26,9 +26,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-from .._pallas import use_pallas as _use_pallas
+from ...compat import CompilerParams
 from .. import _pallas
+from .._pallas import use_pallas as _use_pallas
+
+NEG_INF = -1e30
 
 
 # --------------------------------------------------------------------- forward
@@ -120,7 +122,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt)
@@ -270,7 +272,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g, g_lse=None):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt, dot, lse_p, delta_p)
@@ -294,7 +296,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, g, g_lse=None):
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, h, iq, ik: (bi, h, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq_p, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(qt, kt, vt, dot, lse_p, delta_p)

@@ -30,6 +30,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import CompilerParams
 from .. import _pallas
 from .._pallas import use_pallas as _use_pallas
 
@@ -141,7 +142,7 @@ def paged_attention(q, kpool, vpool, tables, lengths, start_pos, n_tokens, *,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, hq, t_pad, dh), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(*scalars, qt, kpool, vpool)

@@ -19,6 +19,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import axis_size
 from .._pallas import use_pallas as _use_pallas
 from .. import _pallas
 
@@ -127,7 +128,7 @@ def quantized_psum_scatter_int4(x, axis_name: str, group_size: int = 2048):
     all-to-alls the int4 payload, dequantizes, and reduces locally.  x: [n]
     with n divisible by axis size * 2.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     shard = x.shape[0] // world
     xs = x.reshape(world, shard)
     packed, scales, n_per = _quant_a2a_prep(xs, group_size)

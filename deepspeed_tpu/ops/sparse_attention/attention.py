@@ -28,6 +28,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...compat import CompilerParams
 from .. import _pallas
 from .._pallas import use_pallas as _use_pallas
 
@@ -158,7 +159,7 @@ def _sparse_fwd(q, k, v, tables, scale, causal, block):
             jax.ShapeDtypeStruct((b, hq, sp, d), q.dtype),
             jax.ShapeDtypeStruct((b, hq, sp, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(jnp.asarray(tables.kvmap), jnp.asarray(tables.cnt), qt, kt, vt)
@@ -310,7 +311,7 @@ def _sparse_bwd(tables, scale, causal, block, res, g):
             jax.ShapeDtypeStruct((b, hq, sp, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, sp, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(jnp.asarray(tables.qmap), jnp.asarray(tables.cnt_t), qt, kt, vt, dot, lse_p, delta_p)
@@ -340,7 +341,7 @@ def _sparse_bwd(tables, scale, causal, block, res, g):
         kern_q,
         grid_spec=grid_spec_q,
         out_shape=jax.ShapeDtypeStruct((b, hq, sp, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_pallas.INTERPRET,
     )(jnp.asarray(tables.kvmap), jnp.asarray(tables.cnt), qt, kt, vt, dot, lse_p, delta_p)

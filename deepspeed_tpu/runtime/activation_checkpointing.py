@@ -28,7 +28,7 @@ Composition status (measured on this stack, jax 0.9 + the TPU plugin):
 - The POLICY-based offload (``pe.Offloadable``) silently degrades to plain
   recompute — compiled memory for ``offload_residuals`` equals
   ``nothing_saveable`` and host_temp stays 0, even single-chip.
-- The explicit memories API (``jax.device_put(x, jax.memory.Space.Host)``
+- The explicit memories API (``jax.device_put(x, Space.Host)``
   inside jit) DOES work on hardware: ``offload_checkpoint`` below builds
   real cpu_checkpointing from it — a custom-vjp layer wrapper that parks
   each layer's INPUT checkpoint in host memory on the forward and fetches
@@ -51,6 +51,8 @@ from typing import Iterable, Optional
 
 import jax
 from jax.ad_checkpoint import checkpoint_name  # re-export for models
+
+from ..compat import Space
 
 # Residual-stream names models plant; the offload policy targets these.
 RESIDUAL_NAMES = ("attn_resid", "mlp_resid")
@@ -108,7 +110,7 @@ def offload_checkpoint(layer_fn):
     The working cpu_checkpointing path on this stack (see module docstring:
     the policy-based ``Offloadable`` route silently degrades to recompute):
     the forward parks the layer's INPUT activation in host memory
-    (``jax.memory.Space.Host``) and the backward fetches it back and
+    (``compat.Space.Host``) and the backward fetches it back and
     recomputes the layer under ``jax.vjp`` — saved-activation HBM drops to
     ~zero per layer at the cost of one D2H + one H2D of the input per layer
     per step (PCIe on real hosts).  Matches the reference semantics exactly:
@@ -131,7 +133,7 @@ def offload_checkpoint(layer_fn):
     def fwd(x, params, *rest):
         _guard_rest(rest)
         out = layer_fn(x, params, *rest)
-        x_host = jax.device_put(x, jax.memory.Space.Host)
+        x_host = jax.device_put(x, Space.Host)
         return out, (x_host, params, rest)
 
     def _guard_rest(rest):
@@ -155,7 +157,7 @@ def offload_checkpoint(layer_fn):
 
     def bwd(res, g):
         x_host, params, rest = res
-        x = jax.device_put(x_host, jax.memory.Space.Device)
+        x = jax.device_put(x_host, Space.Device)
         _, vjp = jax.vjp(lambda x_, p_: layer_fn(x_, p_, *rest), x, params)
         dx, dp = vjp(g)
         return (dx, dp) + tuple(None for _ in rest)

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...compat import axis_size
+
 
 def compress_signs(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """-> (int8 signs, fp32 scale) with scale = mean(|x|) (reference 1-bit Adam)."""
@@ -44,7 +46,7 @@ def onebit_allreduce(g: jnp.ndarray, error: jnp.ndarray, axis_name: str,
     ``server_error`` is the rank's [n_padded/world] slice buffer (pass zeros on
     first use).
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     n = g.shape[0]
     shard = n // world
     comp = g + error

@@ -12,7 +12,7 @@ Reference: runtime/fp16/onebit/{adam.py:14, lamb.py, zoadam.py} built on
   ``lr * m_reduced / (sqrt(v_frozen) + eps)``.
 
 TPU-native integration: the comm lives INSIDE the optimizer step, so the engine
-runs the whole train step under ``jax.shard_map`` over the dp axes with
+runs the whole train step under ``compat.shard_map`` over the dp axes with
 **replicated params** (the reference likewise restricts 1-bit optimizers to
 ZeRO stage 0/1 semantics; here: stage 0).  Error buffers are optimizer state:
 worker errors are per-rank full-size (engine shards them over dp on a leading

@@ -23,8 +23,10 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec
+
+from ...compat import shard_map
 
 from ...parallel.mesh import DATA_AXIS, PIPE_AXIS, MeshTopology, get_topology
 

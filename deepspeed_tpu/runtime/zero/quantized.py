@@ -8,7 +8,7 @@ runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce):
     choice in sharding.py — gathers ride the fast 'fsdp' axis only)
 
 Under GSPMD the dp reduction/gather collectives are implicit, so the quantized
-variants take explicit control of the wire format with ``jax.shard_map`` over
+variants take explicit control of the wire format with ``compat.shard_map`` over
 the dp axes: gradients are accumulated per-shard, all-to-all'd as packed int4
 (+fp32 group scales), summed locally, and re-gathered in bf16; the updated
 master shards are quantized to int8 before the compute-copy allgather.
@@ -23,8 +23,9 @@ from typing import Any, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
+
+from ...compat import axis_size, shard_map
 
 from ...ops.quantizer.quantize import (quantized_allgather_int8, quantized_psum_scatter_int4)
 from ..grad_accum import accumulate_micro_grads
@@ -40,7 +41,7 @@ def qgz_allreduce(g, axis_name, group_size: int = 2048):
     Runs INSIDE shard_map with ``axis_name`` bound.  Each rank contributes its
     local gradient; returns the replicated mean.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     n = int(np.prod(g.shape))
     if n < MIN_QUANT_SIZE or n < world * 2:
         return jax.lax.pmean(g, axis_name)
@@ -197,7 +198,7 @@ def _qgz_scatter_dim(g, dim, axis_name, group_size, quantize):
     """Reduce-scatter a gradient leaf over the slow axis along ``dim``,
     int4-quantized when ``quantize`` — the stage-3 qgZ hierarchical reduction
     (the fsdp part of the reduction stays on GSPMD auto)."""
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     perm = (dim, ) + tuple(d for d in range(g.ndim) if d != dim)
     gt = g.transpose(perm)
     lead = gt.shape[0]
@@ -240,7 +241,7 @@ def make_zpp3_grad_fn(loss_fn, mesh, plan, gas: int, *, qwz: bool, qgz: bool,
                     g, d, data_axis, group_size, qgz), grads, dims)
             return grads, jax.lax.pmean(loss_sum, data_axis)
 
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                             axis_names={data_axis}, check_vma=False)(master, batch, micro_rngs, scale)
+        return shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         axis_names={data_axis}, check_vma=False)(master, batch, micro_rngs, scale)
 
     return wrapped

@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec
 
+from ..compat import axis_size, shard_map
 from ..parallel.mesh import SEQUENCE_AXIS, MeshTopology, get_topology
 
 NEG_INF = -1e30
@@ -120,7 +121,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
                           softmax_scale: Optional[float] = None):
     """Runs INSIDE shard_map. q/k/v: local [B, s, H, D] shards (kv heads may be
     fewer — GQA).  Returns local [B, s, H, D] output shard."""
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s, hq, d = q.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(d)
@@ -195,7 +196,7 @@ def _ring_attention_zigzag(q, k, v, axis_name: str,
 
     Requires even local seq; callers fall back to the v2 cond-skip path
     otherwise."""
-    P = lax.axis_size(axis_name)
+    P = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s, hq, d = q.shape
     half = s // 2
@@ -288,7 +289,7 @@ def ring_attention(local_attn_unused: Optional[Callable] = None,
                                      causal=causal,
                                      softmax_scale=kw.get("softmax_scale"))
         spec = PartitionSpec(None, seq_axis, None, None)
-        return jax.shard_map(body, mesh=t.mesh, in_specs=(spec, spec, spec),
+        return shard_map(body, mesh=t.mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
 
     return attention_fn

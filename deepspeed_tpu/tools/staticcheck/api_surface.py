@@ -1,0 +1,110 @@
+"""External-API-surface extraction + the committed manifest.
+
+``jax-api-surface`` drift-proofs the WHOLE external jax surface, not just the
+symbols that already burned us: every ``jax.*`` symbol the package touches —
+import or attribute chain — is extracted here and pinned in a committed
+manifest (``.dslint-api-surface.json``).  A symbol in the tree that the
+manifest doesn't pin is a per-call-site lint finding, so the next upstream
+rename/removal surfaces as ONE reviewable manifest diff
+(``bin/dstpu-lint --update-api-surface``) instead of a scatter of red tests.
+
+Extraction is alias-aware and purely syntactic (no imports — the analyzer
+must keep working when jax itself is broken):
+
+- ``import jax.numpy as jnp`` + ``jnp.mean(...)``       → ``jax.numpy.mean``
+- ``from jax import lax`` + ``lax.cond(...)``           → ``jax.lax.cond``
+- ``from jax.sharding import NamedSharding``            → ``jax.sharding.NamedSharding``
+- ``from jax.experimental import multihost_utils``      → pins the module path
+- attribute chains report only their LONGEST spelling (``jax.random.split``,
+  not also ``jax.random``), so one call site is one symbol.
+"""
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .context import ModuleInfo, annotate_parents, parent
+
+MANIFEST_VERSION = 1
+DEFAULT_MANIFEST_NAME = ".dslint-api-surface.json"
+# only files under the package pin surface: tests exercise jax freely and are
+# covered by direct-shimmed-import instead
+PACKAGE_PREFIX = "deepspeed_tpu/"
+
+JAX_ROOTS = frozenset({"jax"})
+
+
+def _tracked(mod_name: str, roots: Iterable[str]) -> bool:
+    return any(mod_name == r or mod_name.startswith(r + ".") for r in roots)
+
+
+def symbol_sites(module: ModuleInfo,
+                 roots: Iterable[str] = JAX_ROOTS) -> Iterator[Tuple[str, ast.AST]]:
+    """Every (fully-qualified symbol, AST node) the module touches under the
+    given root modules.  Yields import statements AND the longest attribute
+    chain at each use site."""
+    tree = module.tree
+    annotate_parents(tree)  # idempotent; callers outside ProjectContext need it
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if not _tracked(alias.name, roots):
+                    continue
+                if alias.asname:
+                    aliases[alias.asname] = alias.name
+                else:
+                    top = alias.name.split(".")[0]
+                    aliases.setdefault(top, top)
+                yield alias.name, node
+        elif isinstance(node, ast.ImportFrom):
+            if node.level or not node.module or not _tracked(node.module, roots):
+                continue
+            for alias in node.names:
+                full = f"{node.module}.{alias.name}"
+                aliases[alias.asname or alias.name] = full
+                yield full, node
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        up = parent(node)
+        if isinstance(up, ast.Attribute) and up.value is node:
+            continue  # an inner link of a longer chain — report the chain once
+        chain: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name) or cur.id not in aliases:
+            continue
+        yield ".".join([aliases[cur.id]] + chain[::-1]), node
+
+
+def collect_api_surface(modules: Iterable[ModuleInfo]) -> Set[str]:
+    """The package's full jax surface (files under ``deepspeed_tpu/`` only)."""
+    surface: Set[str] = set()
+    for mod in modules:
+        if not mod.relpath.startswith(PACKAGE_PREFIX):
+            continue
+        surface.update(sym for sym, _ in symbol_sites(mod))
+    return surface
+
+
+def load_api_surface(path: str) -> Optional[Set[str]]:
+    """Pinned symbols; None when the manifest has never been generated."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != MANIFEST_VERSION:
+        raise ValueError(f"{path}: not a dslint api-surface manifest "
+                         f"(expected version={MANIFEST_VERSION})")
+    return set(data.get("symbols", []))
+
+
+def save_api_surface(path: str, symbols: Set[str]) -> None:
+    with open(path, "w") as fh:
+        json.dump({"version": MANIFEST_VERSION, "symbols": sorted(symbols)},
+                  fh, indent=1)
+        fh.write("\n")

@@ -1,8 +1,9 @@
 """``dstpu-lint`` command line.
 
-    dstpu-lint [paths...]                # default: deepspeed_tpu/
+    dstpu-lint [paths...]                # default: deepspeed_tpu/ + tests/
     dstpu-lint --format json             # machine-readable
     dstpu-lint --update-baseline         # grandfather current findings
+    dstpu-lint --update-api-surface      # re-pin the external jax surface
     dstpu-lint --list-rules
 
 Exit codes: 0 clean, 1 non-baselined findings, 2 usage/internal error.
@@ -12,11 +13,13 @@ import argparse
 import os
 import sys
 
+from .api_surface import (DEFAULT_MANIFEST_NAME, collect_api_surface,
+                          load_api_surface, save_api_surface)
 from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, load_baseline_entries,
                        save_baseline)
 from .reporters import report_json, report_text
 from .rules import META_RULES, RULES, build_rules
-from .runner import run_lint
+from .runner import iter_python_files, load_modules, run_lint
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -24,7 +27,8 @@ def _parser() -> argparse.ArgumentParser:
         prog="dstpu-lint",
         description="JAX/TPU-aware static analysis for deepspeed_tpu (dslint)")
     p.add_argument("paths", nargs="*", default=None,
-                   help="files/directories to lint (default: deepspeed_tpu/)")
+                   help="files/directories to lint (default: deepspeed_tpu/ "
+                        "plus tests/, which only test-scoped rules scan)")
     p.add_argument("--root", default=None,
                    help="repo root for relative paths + default baseline location "
                         "(default: cwd)")
@@ -35,6 +39,12 @@ def _parser() -> argparse.ArgumentParser:
                    help="ignore the baseline file (report everything)")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline from the current findings and exit 0")
+    p.add_argument("--api-surface", default=None,
+                   help="api-surface manifest path "
+                        f"(default: <root>/{DEFAULT_MANIFEST_NAME})")
+    p.add_argument("--update-api-surface", action="store_true",
+                   help="re-pin the package's external jax surface into the "
+                        "manifest and exit 0 (review the diff before committing)")
     p.add_argument("--disable", default="",
                    help="comma-separated rule names to skip")
     p.add_argument("--select", default="",
@@ -55,7 +65,16 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
-    paths = args.paths or [os.path.join(root, "deepspeed_tpu")]
+    if args.paths:
+        paths = args.paths
+    else:
+        # tests/ rides along by default, scanned only by test-scoped rules
+        # (direct-shimmed-import), so a drifted test import is a lint error
+        # instead of a silent collection failure
+        paths = [os.path.join(root, "deepspeed_tpu")]
+        tests_dir = os.path.join(root, "tests")
+        if os.path.isdir(tests_dir):
+            paths.append(tests_dir)
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         print(f"dstpu-lint: no such path(s): {', '.join(missing)}", file=sys.stderr)
@@ -75,6 +94,33 @@ def main(argv=None) -> int:
               "--select/--disable (it would drop the unselected rules' entries)",
               file=sys.stderr)
         return 2
+    api_path = args.api_surface or os.path.join(root, DEFAULT_MANIFEST_NAME)
+    if args.update_api_surface:
+        # same hardening as --update-baseline: the manifest is ALWAYS the whole
+        # package's surface — a rule-restricted or path-restricted run must not
+        # quietly re-pin from a partial view
+        if selected or disabled:
+            print("dstpu-lint: --update-api-surface cannot be combined with "
+                  "--select/--disable (the manifest is rule-independent and "
+                  "always covers the full package)", file=sys.stderr)
+            return 2
+        pkg = os.path.join(root, "deepspeed_tpu")
+        if not os.path.isdir(pkg):
+            print(f"dstpu-lint: no package at {pkg} to pin", file=sys.stderr)
+            return 2
+        modules, errors = load_modules(iter_python_files([pkg]), root)
+        if errors:
+            print(f"dstpu-lint: refusing to update the api-surface manifest with "
+                  f"{len(errors)} unparseable file(s) — the pinned surface would "
+                  f"be incomplete: "
+                  + "; ".join(f"{e.path}:{e.line}" for e in errors[:5]),
+                  file=sys.stderr)
+            return 2
+        surface = collect_api_surface(modules)
+        save_api_surface(api_path, surface)
+        print(f"dstpu-lint: api-surface manifest updated ({len(surface)} "
+              f"symbol(s) over {len(modules)} package files) -> {api_path}")
+        return 0
 
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
     try:
@@ -83,9 +129,16 @@ def main(argv=None) -> int:
     except (ValueError, OSError) as exc:
         print(f"dstpu-lint: bad baseline {baseline_path}: {exc}", file=sys.stderr)
         return 2
+    try:
+        api_surface = load_api_surface(api_path)
+    except (ValueError, OSError) as exc:
+        print(f"dstpu-lint: bad api-surface manifest {api_path}: {exc}",
+              file=sys.stderr)
+        return 2
 
     result = run_lint(paths, root=root, rules=rules, baseline=baseline,
-                      report_unused_suppressions=not args.no_unused_suppressions)
+                      report_unused_suppressions=not args.no_unused_suppressions,
+                      api_surface=api_surface)
 
     if args.update_baseline:
         # meta findings (stale suppressions, bad comments, parse errors) are

@@ -262,6 +262,34 @@ def collect_donation_sites(module: ModuleInfo) -> List[DonationSite]:
     return sites
 
 
+# ---------------------------------------------------------- shimmed symbols
+COMPAT_PATH_FRAGMENT = "deepspeed_tpu/compat/"
+SHIMMED_REGISTRY = "SHIMMED_SYMBOLS"
+
+
+def _shimmed_symbols_from_module(tree: ast.Module) -> Dict[str, Tuple[str, ...]]:
+    """Parse the ``SHIMMED_SYMBOLS`` registry literal out of a compat module:
+    exported name -> ordered "module:attr" candidate spellings.  Read by AST
+    (never by import) so the lint rule works even when jax is broken — and can
+    never go stale relative to what the shim actually covers."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target != SHIMMED_REGISTRY or not isinstance(value, ast.Dict):
+            continue
+        for key, val in zip(value.keys, value.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                out[key.value] = _str_tuple(val)
+    return out
+
+
 # --------------------------------------------------------------- config keys
 CONFIG_BASE_NAMES = {"ConfigModel"}
 EXTRA_KEYS_REGISTRY = "DECLARED_EXTRA_KEYS"
@@ -300,14 +328,23 @@ def _config_keys_from_module(tree: ast.Module) -> Set[str]:
 class ProjectContext:
     """Facts shared by every rule over one lint invocation."""
 
-    def __init__(self, modules: List[ModuleInfo], extra_declared_keys=()):
+    def __init__(self, modules: List[ModuleInfo], extra_declared_keys=(),
+                 api_surface: Optional[Set[str]] = None):
         self.modules = modules
         self.declared_config_keys: Set[str] = set(extra_declared_keys)
+        # exported name -> candidate "module:attr" spellings, read from the
+        # compat package's SHIMMED_SYMBOLS registry (None of it hardcoded here)
+        self.shimmed_symbols: Dict[str, Tuple[str, ...]] = {}
+        # pinned external-API symbols from .dslint-api-surface.json; None when
+        # the manifest has never been generated
+        self.api_surface = api_surface
         self._jit_roots: Dict[str, Dict[int, JitRoot]] = {}
         self._donations: Dict[str, List[DonationSite]] = {}
         for mod in modules:
             annotate_parents(mod.tree)
             self.declared_config_keys |= _config_keys_from_module(mod.tree)
+            if COMPAT_PATH_FRAGMENT in mod.relpath:
+                self.shimmed_symbols.update(_shimmed_symbols_from_module(mod.tree))
             self._jit_roots[mod.relpath] = collect_jit_roots(mod)
             self._donations[mod.relpath] = collect_donation_sites(mod)
 

@@ -35,8 +35,10 @@ suppression reasons left in-tree for the survivors):
 import ast
 from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
-from .context import (ModuleInfo, ProjectContext, enclosing, enclosing_statement,
-                      param_names, parent)
+from .api_surface import (DEFAULT_MANIFEST_NAME, PACKAGE_PREFIX,
+                          collect_api_surface, symbol_sites)
+from .context import (COMPAT_PATH_FRAGMENT, ModuleInfo, ProjectContext, enclosing,
+                      enclosing_statement, param_names, parent)
 from .findings import Finding
 
 RULES: Dict[str, type] = {}
@@ -57,6 +59,10 @@ def register(cls):
 class Rule:
     name = "rule"
     description = ""
+    # most rules encode library contracts (hot-path syncs, config schemas, …)
+    # that don't apply to test code; rules that DO police tests/ opt in and
+    # the runner scopes the rest to package files
+    scan_tests = False
 
     def check(self, module: ModuleInfo, ctx: ProjectContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -652,6 +658,102 @@ class UndeclaredConfigKey(Rule):
                 f"DECLARED_EXTRA_KEYS registry (runtime/config.py) — a typo here "
                 f"silently falls back to the default; declare the key or fix the "
                 f"spelling")
+
+
+# --------------------------------------------------------------------------
+@register
+class DirectShimmedImport(Rule):
+    name = "direct-shimmed-import"
+    description = ("import or attribute use of a jax symbol shimmed by "
+                   "deepspeed_tpu/compat outside compat/ itself — the banned "
+                   "spellings are read from compat's SHIMMED_SYMBOLS registry "
+                   "(by AST, never import), so the rule can't go stale; "
+                   "scans tests/ too")
+    # the one rule that polices test files as well: a drifted test import is a
+    # lint error, not a silent collection failure
+    scan_tests = True
+
+    def check(self, module, ctx):
+        if COMPAT_PATH_FRAGMENT in module.relpath:
+            return
+        # banned fully-qualified spelling -> (exported name, "module:attr")
+        banned: Dict[str, Tuple[str, str]] = {}
+        for exported, specs in ctx.shimmed_symbols.items():
+            for spec in specs:
+                mod_name, _, attr = spec.partition(":")
+                banned[f"{mod_name}.{attr}"] = (exported, spec)
+        if not banned:
+            return
+        roots = {spec.partition(":")[0].split(".")[0]
+                 for _, spec in banned.values()}
+        for symbol, node in symbol_sites(module, roots=roots):
+            hit = next((b for b in banned
+                        if symbol == b or symbol.startswith(b + ".")), None)
+            if hit is None:
+                continue
+            exported, spec = banned[hit]
+            yield self.finding(
+                module, node,
+                f"direct use of '{hit}' — this symbol is version-shimmed; "
+                f"``from deepspeed_tpu.compat import {exported}`` instead "
+                f"(SHIMMED_SYMBOLS['{exported}'] lists the spelling "
+                f"'{spec}'), so the next upstream rename lands as one edit "
+                f"to compat/ instead of red call sites")
+
+
+# --------------------------------------------------------------------------
+@register
+class JaxApiSurface(Rule):
+    name = "jax-api-surface"
+    description = ("external jax.* symbol used by the package but not pinned "
+                   "in the committed api-surface manifest "
+                   f"({DEFAULT_MANIFEST_NAME}) — after a deliberate surface "
+                   "change, regenerate with bin/dstpu-lint "
+                   "--update-api-surface; upstream drift then lands as one "
+                   "reviewable manifest diff")
+
+    def __init__(self):
+        self._missing_reported = False
+        self._stale_reported = False
+
+    def check(self, module, ctx):
+        if not module.relpath.startswith(PACKAGE_PREFIX):
+            return
+        if ctx.api_surface is None:
+            if not self._missing_reported:
+                self._missing_reported = True
+                yield Finding(
+                    rule=self.name, path=DEFAULT_MANIFEST_NAME, line=1, col=0,
+                    message=f"api-surface manifest {DEFAULT_MANIFEST_NAME} does "
+                            f"not exist — generate it once with "
+                            f"'bin/dstpu-lint --update-api-surface' and commit "
+                            f"it; without it the package's external jax surface "
+                            f"is unpinned and upstream drift lands as red tests")
+            return
+        if not self._stale_reported:
+            self._stale_reported = True
+            # ctx covers the whole package even on subset lints (the runner
+            # guarantees it), so staleness is computed against the full tree
+            stale = sorted(ctx.api_surface - collect_api_surface(ctx.modules))
+            if stale:
+                shown = ", ".join(stale[:5]) + ("…" if len(stale) > 5 else "")
+                yield Finding(
+                    rule=self.name, path=DEFAULT_MANIFEST_NAME, line=1, col=0,
+                    message=f"{len(stale)} pinned symbol(s) no longer used by "
+                            f"the package ({shown}) — the manifest must stay "
+                            f"exact; regenerate with 'bin/dstpu-lint "
+                            f"--update-api-surface'",
+                    severity="warning")
+        for symbol, node in symbol_sites(module):
+            if symbol in ctx.api_surface:
+                continue
+            yield self.finding(
+                module, node,
+                f"jax symbol '{symbol}' is not pinned in {DEFAULT_MANIFEST_NAME} "
+                f"— every external jax touch must be manifest-pinned so version "
+                f"drift is a one-file diff; if this use is deliberate, "
+                f"regenerate the manifest with 'bin/dstpu-lint "
+                f"--update-api-surface' (and review the diff)")
 
 
 def build_rules(enabled: Optional[Iterable[str]] = None,

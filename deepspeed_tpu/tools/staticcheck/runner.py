@@ -7,6 +7,7 @@ import os
 import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
+from .api_surface import DEFAULT_MANIFEST_NAME, load_api_surface
 from .baseline import apply_baseline
 from .context import ModuleInfo, ProjectContext
 from .findings import Finding
@@ -14,6 +15,11 @@ from .rules import RULES, Rule, build_rules
 from .suppressions import SuppressionIndex, parse_suppressions
 
 EXCLUDE_DIR_NAMES = {"__pycache__", ".git", ".ipynb_checkpoints"}
+# files under tests/ are scoped to the rules that opt into scanning them
+# (Rule.scan_tests) — library contracts like hot-path syncs don't apply there
+TEST_PATH_PREFIX = "tests/"
+
+_UNSET = object()
 
 
 @dataclasses.dataclass
@@ -87,19 +93,22 @@ def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
                  extra_declared_keys: Iterable[str] = (),
                  report_unused_suppressions: bool = True,
                  context_modules: Optional[List[ModuleInfo]] = None,
+                 api_surface=None,
                  _stats: Optional[Dict[str, int]] = None) -> List[Finding]:
     """Findings come only from ``modules``; ``context_modules`` (a superset,
     default = modules) feeds ProjectContext so a subset lint still sees the
     whole package's schemas/registries."""
     rules = rules if rules is not None else build_rules()
     ctx = ProjectContext(context_modules or modules,
-                         extra_declared_keys=extra_declared_keys)
-    ran = {r.name for r in rules}
+                         extra_declared_keys=extra_declared_keys,
+                         api_surface=api_surface)
     findings: List[Finding] = []
     suppressed = 0
     for mod in modules:
+        mod_rules = rules if not mod.relpath.startswith(TEST_PATH_PREFIX) \
+            else [r for r in rules if r.scan_tests]
         raw: List[Finding] = []
-        for rule in rules:
+        for rule in mod_rules:
             raw.extend(rule.check(mod, ctx))
         suppressions, problems = parse_suppressions(mod.source, mod.relpath)
         index = SuppressionIndex(suppressions)
@@ -107,7 +116,7 @@ def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
         suppressed += len(raw) - len(kept)
         kept.extend(problems)
         if report_unused_suppressions:
-            for s in index.unused(ran):
+            for s in index.unused({r.name for r in mod_rules}):
                 kept.append(Finding(
                     rule="unused-suppression", path=mod.relpath, line=s.line, col=s.col,
                     message=f"suppression of {', '.join(s.rules)} matched no finding — "
@@ -122,12 +131,17 @@ def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
 def run_lint(paths: Sequence[str], root: Optional[str] = None,
              rules: Optional[List[Rule]] = None,
              baseline: Optional[Dict[str, int]] = None,
-             report_unused_suppressions: bool = True) -> LintResult:
+             report_unused_suppressions: bool = True,
+             api_surface=_UNSET) -> LintResult:
     t0 = time.perf_counter()
     root = root or os.getcwd()
     files = iter_python_files(paths)
     modules, errors = load_modules(files, root)
     rules = rules if rules is not None else build_rules()
+    if api_surface is _UNSET:
+        # default: the committed manifest at the repo root (None = never
+        # generated, which jax-api-surface reports as its own finding)
+        api_surface = load_api_surface(os.path.join(root, DEFAULT_MANIFEST_NAME))
     # linting a SUBSET still needs whole-package context (ConfigModel schemas,
     # the DECLARED_EXTRA_KEYS registry) or declared-key checks mass-misfire
     context_modules = modules
@@ -141,7 +155,7 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
     stats: Dict[str, int] = {}
     all_findings = errors + lint_modules(
         modules, rules, report_unused_suppressions=report_unused_suppressions,
-        context_modules=context_modules, _stats=stats)
+        context_modules=context_modules, api_surface=api_surface, _stats=stats)
     active, baselined = apply_baseline(all_findings, baseline or {})
     checked = sorted({m.relpath for m in modules} | {e.path for e in errors})
     return LintResult(findings=active, baselined=baselined,
@@ -155,8 +169,16 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
 def lint_source(source: str, filename: str = "snippet.py",
                 rule_names: Optional[Sequence[str]] = None,
                 extra_declared_keys: Iterable[str] = (),
-                report_unused_suppressions: bool = False) -> List[Finding]:
-    """Test/fixture helper: lint one source string in isolation."""
+                report_unused_suppressions: bool = False,
+                context_sources: Optional[Dict[str, str]] = None,
+                api_surface=None) -> List[Finding]:
+    """Test/fixture helper: lint one source string in isolation.
+
+    ``context_sources`` ({filename: source}) joins the ProjectContext without
+    being linted — e.g. a fake ``deepspeed_tpu/compat/__init__.py`` carrying a
+    SHIMMED_SYMBOLS registry for direct-shimmed-import fixtures.
+    ``api_surface`` is the pinned-symbol set for jax-api-surface fixtures
+    (None = manifest never generated)."""
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as exc:
@@ -164,6 +186,12 @@ def lint_source(source: str, filename: str = "snippet.py",
                         col=0, message=str(exc))]
     mod = ModuleInfo(path=filename, relpath=filename, source=source, tree=tree,
                      lines=source.splitlines())
+    context = [mod]
+    for ctx_name, ctx_src in (context_sources or {}).items():
+        context.append(ModuleInfo(path=ctx_name, relpath=ctx_name, source=ctx_src,
+                                  tree=ast.parse(ctx_src, filename=ctx_name),
+                                  lines=ctx_src.splitlines()))
     rules = build_rules(rule_names) if rule_names is not None else build_rules()
     return lint_modules([mod], rules, extra_declared_keys=extra_declared_keys,
-                        report_unused_suppressions=report_unused_suppressions)
+                        report_unused_suppressions=report_unused_suppressions,
+                        context_modules=context, api_surface=api_surface)

@@ -712,16 +712,20 @@ def run_lane(name: str, marker_args):
 
 
 def run_lint_lane():
-    """dslint over the whole package (ISSUE 3): fails CI on any non-baselined
-    finding.  Subprocesses bin/dstpu-lint (which loads the pure-AST analyzer
-    standalone, never through deepspeed_tpu/__init__) so the lint lane still
-    reports when the library itself is broken at import time — exactly when a
-    static check is most wanted."""
+    """dslint over the whole package AND tests/ (ISSUE 3 + ISSUE 10): fails CI
+    on any non-baselined finding.  tests/ is scanned by the test-scoped rules
+    only (direct-shimmed-import), so a drifted test import is a lint error
+    instead of a silent collection failure.  Subprocesses bin/dstpu-lint (which
+    loads the pure-AST analyzer standalone, never through
+    deepspeed_tpu/__init__) so the lint lane still reports when the library
+    itself is broken at import time — exactly when a static check is most
+    wanted."""
     import os
     t0 = time.time()
     root = os.path.dirname(os.path.abspath(__file__))
     proc = subprocess.run([sys.executable, os.path.join(root, "bin", "dstpu-lint"),
-                           os.path.join(root, "deepspeed_tpu"), "--root", root,
+                           os.path.join(root, "deepspeed_tpu"),
+                           os.path.join(root, "tests"), "--root", root,
                            "--format", "json"],
                           capture_output=True, text=True)
     dt = time.time() - t0
@@ -744,6 +748,51 @@ def run_lint_lane():
             "summary": tail, **counts}
 
 
+# The test files of the kernel/onebit/TP/sequence families that jax-0.4.37
+# drift (shard_map / CompilerParams / axis_size / memories API) failed
+# WHOLESALE before the compat/ shim (ISSUE 10).  This lane gates them
+# HARD-GREEN — no "failure set identical to seed" allowance — because these
+# are exactly the sharded kernels and TP paths the multichip ROADMAP items
+# must regress against.
+DRIFT_FAMILY_FILES = [
+    "tests/unit/ops/test_flash_attention.py",
+    "tests/unit/ops/test_sparse_attention.py",
+    "tests/unit/ops/test_quantizer.py",
+    "tests/unit/test_onebit.py",
+    "tests/unit/test_sequence_parallel.py",
+    "tests/unit/test_pipeline.py",
+    "tests/unit/test_zeropp.py",
+    "tests/unit/test_comm.py",
+    "tests/unit/test_aux_subsystems.py",
+    "tests/unit/test_activation_checkpointing.py",
+    "tests/unit/test_multiprocess.py",
+    "tests/unit/test_model_families.py",
+    "tests/unit/test_tensor_parallel.py",
+    "tests/unit/test_compat.py",
+    "tests/unit/inference/test_inference_v1.py",
+    "tests/unit/inference/test_inference_v2_tp.py",
+]
+
+
+def run_drift_families_lane():
+    """Hard-green gate over the previously-drifted families: any failure or
+    collection error here is a regression in code the compat shim re-greened
+    (kernels, onebit, TP, sequence, pipeline, ZeRO++, multiprocess)."""
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "-m", "pytest", *DRIFT_FAMILY_FILES,
+                           "-q", "-m", "not slow"],
+                          capture_output=True, text=True)
+    dt = time.time() - t0
+    tail = (proc.stdout.strip().splitlines() or [""])[-1]
+    counts = {k: int(v) for v, k in re.findall(r"(\d+) (passed|failed|error|skipped|deselected)", tail)}
+    print(f"[drift_families] {tail}  ({dt:.0f}s)")
+    if proc.returncode != 0:
+        print(proc.stdout[-4000:])
+        print(proc.stderr[-2000:], file=sys.stderr)
+    return {"name": "drift_families", "rc": proc.returncode,
+            "seconds": round(dt, 1), "summary": tail, **counts}
+
+
 def main():
     lanes = [run_lint_lane(),
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
@@ -751,6 +800,7 @@ def main():
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
+             run_drift_families_lane(),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
     with open("TESTS_LANES.json", "w") as fh:
@@ -776,4 +826,6 @@ if __name__ == "__main__":
         sys.exit(elastic_smoke())
     if "--lint" in sys.argv:
         sys.exit(run_lint_lane()["rc"])
+    if "--drift-families" in sys.argv:
+        sys.exit(run_drift_families_lane()["rc"])
     sys.exit(main())

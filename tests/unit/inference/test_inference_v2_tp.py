@@ -139,8 +139,9 @@ def test_candidate_sample_matches_full_vocab_distribution():
     to candidate ordering — checked by empirical frequencies over one batched
     draw (the row is tiled N_DRAWS times; each row samples independently)."""
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.compat import shard_map
 
     from deepspeed_tpu.inference.engine import _sample
     from deepspeed_tpu.inference.v2.engine_v2 import candidate_sample

@@ -11,8 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.ops.quantizer import (dequantize_int4, dequantize_int8, quantize_int4,
                                          quantize_int8, quantized_allgather_int8,
                                          quantized_psum_scatter_int4)

@@ -1,0 +1,352 @@
+"""Fixtures for the two drift-proofing rules (ISSUE 10): per-rule positive /
+negative snippets for ``direct-shimmed-import`` and ``jax-api-surface``, plus
+the ``--update-api-surface`` CLI contract (regeneration, the --select/--disable
+refusal matching the baseline-update hardening, and the tests/ scan root)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.tools.staticcheck import lint_source
+from deepspeed_tpu.tools.staticcheck.api_surface import (collect_api_surface,
+                                                         load_api_surface,
+                                                         save_api_surface,
+                                                         symbol_sites)
+from deepspeed_tpu.tools.staticcheck.cli import main
+from deepspeed_tpu.tools.staticcheck.runner import load_modules
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+# a minimal compat registry — the rule reads THIS, not a hardcoded list
+FAKE_COMPAT = textwrap.dedent("""
+    SHIMMED_SYMBOLS = {
+        "shard_map": ("jax:shard_map", "jax.experimental.shard_map:shard_map"),
+        "CompilerParams": ("jax.experimental.pallas.tpu:CompilerParams",
+                           "jax.experimental.pallas.tpu:TPUCompilerParams"),
+    }
+    """)
+CTX = {"deepspeed_tpu/compat/__init__.py": FAKE_COMPAT}
+
+
+def run(src, filename="deepspeed_tpu/mod.py", **kw):
+    return lint_source(textwrap.dedent(src), filename=filename,
+                       rule_names=["direct-shimmed-import"],
+                       context_sources=CTX, **kw)
+
+
+class TestDirectShimmedImport:
+    def test_flags_from_jax_import(self):
+        out = run("from jax import shard_map\n")
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+        assert "deepspeed_tpu.compat import shard_map" in out[0].message
+
+    def test_flags_the_real_drifted_test_idiom_in_tests(self):
+        # the exact breakage that took out test_comm.py at collection: a
+        # drifted import in a TEST file must be a lint error, not a silent
+        # collection failure
+        out = run("""
+            import jax
+            from jax import shard_map
+            """, filename="tests/unit/test_comm.py")
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+        assert out[0].line == 3
+
+    def test_flags_attribute_call_form(self):
+        out = run("""
+            import jax
+            f = jax.shard_map(body, mesh=mesh, in_specs=s, out_specs=s)
+            """)
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+
+    def test_flags_old_module_path_and_its_alias(self):
+        out = run("from jax.experimental.shard_map import shard_map\n")
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+        out = run("""
+            import jax.experimental.shard_map as shmap
+            f = shmap.shard_map(body)
+            """)
+        assert "direct-shimmed-import" in [f.rule for f in out]
+
+    @pytest.mark.parametrize("attr", ["CompilerParams", "TPUCompilerParams"])
+    def test_flags_both_compiler_params_spellings(self, attr):
+        # BOTH directions are banned: the old name must not linger, the new
+        # name must not be imported around the shim
+        out = run(f"""
+            from jax.experimental.pallas import tpu as pltpu
+            p = pltpu.{attr}(dimension_semantics=("parallel",))
+            """)
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+        assert attr in out[0].message
+
+    def test_compat_package_itself_is_exempt(self):
+        out = run("import jax\nf = jax.shard_map\n",
+                  filename="deepspeed_tpu/compat/resolution.py")
+        assert out == []
+
+    def test_compat_import_is_the_sanctioned_spelling(self):
+        out = run("""
+            from deepspeed_tpu.compat import CompilerParams, shard_map
+            """, filename="tests/unit/test_x.py")
+        assert out == []
+
+    def test_registry_grows_without_touching_the_rule(self):
+        # stale-proofing: adding a symbol to SHIMMED_SYMBOLS immediately bans
+        # its spellings — the rule itself hardcodes nothing
+        grown = FAKE_COMPAT.replace(
+            '"shard_map":',
+            '"axis_size": ("jax.lax:axis_size",),\n    "shard_map":')
+        out = lint_source("import jax\nw = jax.lax.axis_size('data')\n",
+                          filename="deepspeed_tpu/mod.py",
+                          rule_names=["direct-shimmed-import"],
+                          context_sources={
+                              "deepspeed_tpu/compat/__init__.py": grown})
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+
+    def test_silent_without_a_registry_in_context(self):
+        out = lint_source("from jax import shard_map\n",
+                          filename="deepspeed_tpu/mod.py",
+                          rule_names=["direct-shimmed-import"])
+        assert out == []
+
+    def test_real_in_tree_registry_parses_and_bans(self):
+        real = open(os.path.join(REPO, "deepspeed_tpu", "compat",
+                                 "__init__.py")).read()
+        out = lint_source("import jax\nw = jax.lax.axis_size('x')\n",
+                          filename="deepspeed_tpu/mod.py",
+                          rule_names=["direct-shimmed-import"],
+                          context_sources={
+                              "deepspeed_tpu/compat/__init__.py": real})
+        assert [f.rule for f in out] == ["direct-shimmed-import"]
+
+    def test_suppressible_with_reason(self):
+        out = run("""
+            from jax import shard_map  # dslint: disable=direct-shimmed-import  # migration shim test fixture
+            """)
+        assert out == []
+
+
+def surf(src, filename="deepspeed_tpu/mod.py", api_surface=None):
+    return lint_source(textwrap.dedent(src), filename=filename,
+                       rule_names=["jax-api-surface"], api_surface=api_surface)
+
+
+class TestJaxApiSurface:
+    def test_unpinned_symbol_flagged_per_call_site(self):
+        out = surf("""
+            import jax
+            a = jax.jit(f)
+            b = jax.renamed_upstream(f)
+            """, api_surface={"jax", "jax.jit"})
+        assert [f.rule for f in out] == ["jax-api-surface"]
+        assert out[0].line == 4 and "jax.renamed_upstream" in out[0].message
+
+    def test_alias_resolution_pins_canonical_names(self):
+        out = surf("""
+            import jax.numpy as jnp
+            from jax import lax
+            x = jnp.mean(y)
+            z = lax.cond(p, f, g)
+            """, api_surface={"jax.numpy", "jax.numpy.mean", "jax.lax",
+                              "jax.lax.cond"})
+        assert out == []
+
+    def test_import_from_form_is_a_pin_site(self):
+        out = surf("from jax.sharding import NamedSharding\n",
+                   api_surface=set())
+        assert [f.rule for f in out] == ["jax-api-surface"]
+        assert "jax.sharding.NamedSharding" in out[0].message
+
+    def test_longest_chain_reported_once(self):
+        out = surf("""
+            import jax
+            k = jax.random.split(key)
+            """, api_surface={"jax"})
+        # one finding for jax.random.split, not also one for jax.random
+        assert len(out) == 1 and "jax.random.split" in out[0].message
+
+    def test_test_files_are_not_surface(self):
+        out = surf("import jax\nx = jax.whatever(y)\n",
+                   filename="tests/unit/test_x.py", api_surface={"jax"})
+        assert out == []
+
+    def test_missing_manifest_is_one_actionable_finding(self):
+        out = surf("import jax\n", api_surface=None)
+        assert [f.rule for f in out] == ["jax-api-surface"]
+        assert "--update-api-surface" in out[0].message
+
+    def test_stale_pin_is_reported(self):
+        out = surf("import jax\n", api_surface={"jax", "jax.retired_thing"})
+        assert len(out) == 1 and out[0].severity == "warning"
+        assert "jax.retired_thing" in out[0].message
+
+    def test_non_jax_modules_ignored(self):
+        out = surf("""
+            import numpy as np
+            import os.path
+            x = np.mean(y) + os.path.join(a, b)
+            """, api_surface=set())
+        assert out == []
+
+
+class TestSurfaceExtraction:
+    def _sites(self, src, filename="deepspeed_tpu/m.py"):
+        import ast
+        from deepspeed_tpu.tools.staticcheck.context import ModuleInfo
+        src = textwrap.dedent(src)
+        mod = ModuleInfo(path=filename, relpath=filename, source=src,
+                         tree=ast.parse(src), lines=src.splitlines())
+        return sorted({s for s, _ in symbol_sites(mod)})
+
+    def test_chains_stop_at_calls(self):
+        assert self._sites("""
+            import jax
+            s = jax.random.split(key).shape
+            """) == ["jax", "jax.random.split"]
+
+    def test_plain_module_import_binds_top_name(self):
+        assert self._sites("""
+            import jax.numpy
+            x = jax.numpy.float32
+            """) == ["jax.numpy", "jax.numpy.float32"]
+
+    def test_collect_is_package_scoped(self):
+        import ast
+        from deepspeed_tpu.tools.staticcheck.context import ModuleInfo
+
+        def mk(name, src):
+            return ModuleInfo(path=name, relpath=name, source=src,
+                              tree=ast.parse(src), lines=src.splitlines())
+        mods = [mk("deepspeed_tpu/a.py", "import jax\nx = jax.jit\n"),
+                mk("tests/unit/t.py", "import jax\ny = jax.test_only\n")]
+        assert collect_api_surface(mods) == {"jax", "jax.jit"}
+
+
+DRIFTED_TEST = "from jax import shard_map\n"
+
+
+@pytest.fixture
+def tree(tmp_path):
+    pkg = tmp_path / "deepspeed_tpu"
+    (pkg / "compat").mkdir(parents=True)
+    (pkg / "compat" / "__init__.py").write_text(FAKE_COMPAT)
+    (pkg / "mod.py").write_text("import jax\nx = jax.jit\n")
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_ok.py").write_text("def test_x():\n    assert True\n")
+    return tmp_path
+
+
+def run_cli(args, capsys):
+    rc = main(args)
+    out = capsys.readouterr()
+    return rc, out.out + out.err
+
+
+class TestUpdateApiSurfaceCli:
+    def test_regenerates_manifest_and_lints_clean(self, tree, capsys):
+        rc, out = run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        assert rc == 0 and "manifest updated" in out
+        manifest = load_api_surface(str(tree / ".dslint-api-surface.json"))
+        assert "jax.jit" in manifest
+        rc, _ = run_cli(["--root", str(tree)], capsys)
+        assert rc == 0
+
+    def test_unpinned_symbol_fails_until_regenerated(self, tree, capsys):
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        (tree / "deepspeed_tpu" / "mod.py").write_text(
+            "import jax\nx = jax.jit\ny = jax.brand_new_api\n")
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 1 and "jax.brand_new_api" in out
+        rc, _ = run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        assert rc == 0
+        rc, _ = run_cli(["--root", str(tree)], capsys)
+        assert rc == 0
+
+    def test_stale_pin_fails_until_regenerated(self, tree, capsys):
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        (tree / "deepspeed_tpu" / "mod.py").write_text("VALUE = 3\n")
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 1 and "no longer used" in out
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        rc, _ = run_cli(["--root", str(tree)], capsys)
+        assert rc == 0
+
+    def test_refuses_select_and_disable(self, tree, capsys):
+        # matches the --update-baseline hardening: a restricted run must not
+        # quietly re-pin the manifest
+        rc, out = run_cli(["--root", str(tree), "--update-api-surface",
+                           "--select", "jax-api-surface"], capsys)
+        assert rc == 2 and "--select" in out
+        rc, out = run_cli(["--root", str(tree), "--update-api-surface",
+                           "--disable", "silent-except"], capsys)
+        assert rc == 2
+
+    def test_refuses_unparseable_package(self, tree, capsys):
+        (tree / "deepspeed_tpu" / "broken.py").write_text("def f(:\n")
+        rc, out = run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        assert rc == 2 and "unparseable" in out
+
+    def test_missing_manifest_fails_lint_with_remedy(self, tree, capsys):
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 1 and "--update-api-surface" in out
+
+
+class TestTestsScanRoot:
+    def test_default_paths_cover_tests_for_shimmed_imports(self, tree, capsys):
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        (tree / "tests" / "test_drifted.py").write_text(DRIFTED_TEST)
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 1 and "direct-shimmed-import" in out
+        assert "tests/test_drifted.py" in out
+
+    def test_other_rules_do_not_scan_tests(self, tree, capsys):
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        (tree / "tests" / "test_messy.py").write_text(textwrap.dedent("""
+            def test_x():
+                try:
+                    helper()
+                except Exception:
+                    pass
+            """))
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 0, out  # silent-except is a library contract, not a test one
+
+    def test_package_rules_unchanged_by_tests_root(self, tree, capsys):
+        run_cli(["--root", str(tree), "--update-api-surface"], capsys)
+        (tree / "deepspeed_tpu" / "messy.py").write_text(textwrap.dedent("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+            """))
+        rc, out = run_cli(["--root", str(tree)], capsys)
+        assert rc == 1 and "silent-except" in out
+
+
+class TestInTreeAcceptance:
+    def test_package_and_tests_lint_clean_with_both_rules(self):
+        """The whole tree — package AND tests — is clean under the two new
+        rules against the committed manifest and the real compat registry."""
+        from deepspeed_tpu.tools.staticcheck.runner import run_lint
+        result = run_lint([os.path.join(REPO, "deepspeed_tpu"),
+                           os.path.join(REPO, "tests")], root=REPO)
+        assert "direct-shimmed-import" in result.rules_run
+        assert "jax-api-surface" in result.rules_run
+        offending = [f for f in result.findings
+                     if f.rule in ("direct-shimmed-import", "jax-api-surface")]
+        assert not offending, [f.format_text() for f in offending]
+
+    def test_committed_manifest_is_exact(self):
+        manifest = load_api_surface(os.path.join(REPO, ".dslint-api-surface.json"))
+        assert manifest, "manifest missing or empty — run --update-api-surface"
+        files = []
+        for root, dirs, names in os.walk(os.path.join(REPO, "deepspeed_tpu")):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            files += [os.path.join(root, n) for n in names if n.endswith(".py")]
+        modules, errors = load_modules(sorted(files), REPO)
+        assert not errors
+        assert collect_api_surface(modules) == manifest

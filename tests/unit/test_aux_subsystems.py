@@ -7,9 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.compression import (fake_quantize, init_compression, row_prune_mask,
                                        sparse_prune_mask)
 from deepspeed_tpu.runtime.comm import onebit_allreduce

@@ -7,9 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu import comm
 from deepspeed_tpu.parallel import MeshTopology
 from deepspeed_tpu.utils.comms_logging import calc_bw_log, get_comms_logger

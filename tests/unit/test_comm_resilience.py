@@ -3,8 +3,10 @@ silent distributed deadlock becomes a fast, named CollectiveTimeoutError; the
 heartbeat is stamped around the blocking wait so the agent's hang dump can
 name the collective; process-group setup retries transient failures.
 
-Separate from test_comm.py so these run even when the in-graph collective
-tests are blocked by jax API drift (they need no mesh, no shard_map)."""
+Separate from test_comm.py so these run even if the in-graph collective
+tests are ever blocked again by jax API drift (they need no mesh, no
+shard_map — and test_comm.py itself now imports through
+deepspeed_tpu.compat, with dslint banning direct drifted spellings)."""
 
 import json
 import time

@@ -6,9 +6,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.models.transformer import sdpa
 from deepspeed_tpu.parallel import MeshTopology, set_topology
 from deepspeed_tpu.sequence import DistributedAttention, single_all_to_all, ulysses_attention
@@ -272,7 +272,7 @@ def test_ring_zigzag_equals_v2_schedule(seq_topo):
     spec = P(None, "sequence", None, None)
 
     def run(body):
-        return np.asarray(jax.jit(jax.shard_map(
+        return np.asarray(jax.jit(shard_map(
             body, mesh=seq_topo.mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False))(*args))
 

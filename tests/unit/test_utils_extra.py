@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu
+from deepspeed_tpu.compat import shard_map
 from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
 from deepspeed_tpu.utils import (safe_get_full_fp32_param, safe_get_full_optimizer_state,
                                  safe_set_full_fp32_param)
@@ -132,9 +133,9 @@ def test_sparse_tensor_allreduce(mesh8):
         total = sparse_all_reduce(st, "data")
         return total.to_dense()
 
-    fn = jax.shard_map(reduce_local, mesh=mesh8.mesh,
-                       in_specs=(PartitionSpec("data"), PartitionSpec("data")),
-                       out_specs=PartitionSpec(), check_vma=False)
+    fn = shard_map(reduce_local, mesh=mesh8.mesh,
+                   in_specs=(PartitionSpec("data"), PartitionSpec("data")),
+                   out_specs=PartitionSpec(), check_vma=False)
     dense = fn(ids, douts)
     # reference: dense scatter-add of all contributions
     ref = np.zeros((vocab, dim), np.float32)
