@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -72,6 +72,14 @@ serving-fastpath-smoke:
 # run; also a lane in run_tests.py
 tracing-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --tracing-smoke
+
+# ops plane (ISSUE 11): mixed-arrival serve with the ops server ON — /metrics
+# scrapes mid-serve and after must strict-parse as Prometheus 0.0.4 exposing
+# shed/preempt/fastpath counters + TTFT/TBT/e2e histograms, /healthz mirrors
+# health(), and the fastpath ServeCounters are byte-identical server on vs
+# off (scrapes read host-side cached snapshots; zero added device syncs)
+ops-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --ops-smoke
 
 # serving fault tolerance (ISSUE 8): kill a real serving worker mid-decode;
 # supervised restart + journal replay must bring every request to a terminal

@@ -872,7 +872,34 @@ def measure_serving_mixed(on_tpu: bool):
             "serving_mixed_burst_fraction": round(link["burst_tokens"] / max(tokens, 1), 3),
             # durability tax (ISSUE 8): tok/s with the request journal armed
             # vs off, same scenario (fsync_every=0; see comment above)
-            "serving_mixed_journal_overhead_pct": journal_overhead_pct}
+            "serving_mixed_journal_overhead_pct": journal_overhead_pct,
+            # ops-plane refresh cost (ISSUE 11): one full cache rebuild —
+            # registry populate from engine host state + Prometheus render +
+            # health()/state_snapshot() JSON — i.e. what a serve-loop refresh
+            # tick costs the host (scrapes themselves read the cached strings
+            # and cost the serve loop nothing)
+            **_ops_refresh_cost(eng)}
+
+
+def _ops_refresh_cost(eng, rounds: int = 20):
+    """Median wall cost of one ops cache refresh on a live engine, plus the
+    family count the endpoint would expose — the operator-facing price tag
+    of `ops_server.refresh_interval_s`."""
+    from deepspeed_tpu.monitor.exposition import render
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry, populate_from_engine
+    reg = MetricsRegistry()
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        populate_from_engine(reg, eng)
+        text = render(reg, collect=False)
+        json.dumps(eng.health())
+        json.dumps(eng.state_snapshot())
+        times.append(time.perf_counter() - t0)
+    return {"serving_mixed_ops_refresh_ms": round(
+                float(np.median(times)) * 1e3, 3),
+            "serving_mixed_ops_metrics_families": len(reg.families),
+            "serving_mixed_ops_metrics_bytes": len(text)}
 
 
 def measure_fsdp_virtual(timeout_s: int = 280):

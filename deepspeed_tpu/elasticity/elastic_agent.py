@@ -45,10 +45,12 @@ exactly as after a cold restart, which is the reference's recovery model too
 configuration).
 """
 
+import json
 import os
 import signal
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -57,8 +59,9 @@ from ..monitor.tracing import FlightRecorder
 from ..runtime.checkpointing import is_valid_tag, list_tags
 from ..runtime.heartbeat import (COLLECTIVE_TIMEOUT_ENV, HEARTBEAT_DIR_ENV,
                                  HEARTBEAT_INTERVAL_ENV, INIT_RETRIES_ENV,
-                                 INIT_RETRY_BACKOFF_ENV, RESUME_DIR_ENV,
-                                 RESUME_TAG_ENV, format_hang_report, heartbeat_age,
+                                 INIT_RETRY_BACKOFF_ENV, OPS_DIR_ENV,
+                                 RESUME_DIR_ENV, RESUME_TAG_ENV,
+                                 format_hang_report, heartbeat_age,
                                  read_heartbeats, stale_ranks, straggler_ranks)
 from ..utils.logging import logger
 from .elasticity import get_valid_gpus
@@ -155,6 +158,10 @@ class DSElasticAgent:
     ``DSTPU_RESUME_TAG``.
     """
 
+    # merged-metrics rebuild throttle (the poll loop ticks much faster; a
+    # scrape between rebuilds reads the cached strings)
+    OPS_REFRESH_INTERVAL_S = 0.25
+
     def __init__(self, worker_cmd: Sequence[str], world_size: int,
                  elastic_config: Optional[Dict] = None, max_restarts: int = 3,
                  poll_interval: float = 0.2, env: Optional[Dict[str, str]] = None,
@@ -172,7 +179,10 @@ class DSElasticAgent:
                  collective_timeout_s: Optional[float] = None,
                  init_retries: Optional[int] = None,
                  init_retry_backoff_s: Optional[float] = None,
-                 telemetry=None, recorder_events: int = 256):
+                 telemetry=None, recorder_events: int = 256,
+                 ops_port: Optional[int] = None,
+                 ops_dir: Optional[str] = None,
+                 ops_host: str = "127.0.0.1"):
         self.worker_cmd = list(worker_cmd)
         self.initial_world = world_size
         self.elastic_config = elastic_config
@@ -210,6 +220,33 @@ class DSElasticAgent:
         self._last_heartbeats: Dict[int, dict] = {}
         self._interrupt_signum: Optional[int] = None
         self._prev_handlers: Dict[int, object] = {}
+        # fleet-level ops endpoint (ISSUE 11): workers publish per-rank
+        # registry snapshots under DSTPU_OPS_DIR (this agent exports it), the
+        # poll loop merges them (generation carry keeps counters monotone
+        # across restarts/rescales) and serves /metrics + /healthz + /statez
+        # with per-rank liveness gauges on top — the health surface a fleet
+        # router admits on.  `ops_port` arms it (0 = ephemeral; read
+        # agent.ops.port); `ops_dir` defaults to a tempdir.
+        self.ops = None
+        self._ops_cache = None
+        self._ops_agg = None
+        self._ops_dir = ops_dir
+        self._ops_own_dir = False
+        self._current_world = world_size
+        if ops_port is not None or ops_dir is not None:
+            from ..monitor.metrics import FleetAggregator
+            from ..monitor.ops_server import OpsCache, try_start_ops_server
+            self._ops_agg = FleetAggregator()
+            self._ops_cache = OpsCache()
+            if self._ops_dir is None:
+                self._ops_dir = tempfile.mkdtemp(prefix="dstpu_elastic_ops_")
+                self._ops_own_dir = True
+            if ops_port is not None:
+                self.ops = try_start_ops_server(self._ops_cache, host=ops_host,
+                                                port=ops_port,
+                                                owner="elastic agent")
+            self._ops_last_refresh = -float("inf")
+            self._refresh_ops(group=None, force=True)
 
     # ------------------------------------------------------------- world math
     def valid_world_sizes(self) -> List[int]:
@@ -248,6 +285,62 @@ class DSElasticAgent:
             "events": self.recorder.tail(),
             "heartbeats": dict(self._last_heartbeats),
         }
+
+    # ----------------------------------------------------------- ops endpoint
+    def ops_health(self, group: Optional[WorkerGroup] = None) -> Dict:
+        """The agent's /healthz: world/restart state + per-rank liveness —
+        host-side values the poll loop already maintains."""
+        alive = group.alive_ranks() if group is not None else []
+        return {
+            "world_size": self._current_world,
+            "restart_count": self.restart_count,
+            "max_restarts": self.max_restarts,
+            "alive_ranks": alive,
+            "resume_tags": list(self.resume_tags),
+            "ranks_reporting": (self._ops_agg.ranks()
+                                if self._ops_agg is not None else []),
+        }
+
+    def _refresh_ops(self, group: Optional[WorkerGroup],
+                     force: bool = False) -> None:
+        """Merge worker snapshots + agent liveness into the scrape cache.
+        Runs on the agent's poll loop (host-only file reads + string work),
+        throttled to one rebuild per ``ops_server`` refresh interval so a
+        fast poll_interval doesn't pay a dir-scan + render every tick."""
+        if self._ops_agg is None:
+            return
+        now_mono = time.monotonic()
+        if not force and now_mono - self._ops_last_refresh < self.OPS_REFRESH_INTERVAL_S:
+            return
+        self._ops_last_refresh = now_mono
+        from ..monitor.exposition import render
+        from ..monitor.metrics import populate_from_agent
+        from ..monitor.ops_server import read_rank_snapshots
+        from ..utils.logging import warning_once
+        for rank, snap in read_rank_snapshots(self._ops_dir).items():
+            try:
+                self._ops_agg.absorb(rank, snap)
+            except (ValueError, KeyError, TypeError) as exc:
+                # a malformed-but-parseable snapshot degrades that rank's
+                # freshness; it must never unwind the poll loop that owns
+                # every worker's teardown
+                warning_once(f"ops: rank {rank} snapshot rejected ({exc!r}); "
+                             f"keeping its last merged state")
+        merged = self._ops_agg.registry()
+        populate_from_agent(merged, self,
+                            heartbeats=self._last_heartbeats,
+                            alive_ranks=group.alive_ranks() if group else None,
+                            now=time.time())
+        merged.set_gauge("dstpu_elastic_world_size", self._current_world,
+                         help_text="current worker-group world size")
+        self._ops_cache.update(metrics_text=render(merged, collect=False),
+                               healthz=json.dumps(self.ops_health(group)),
+                               statez=json.dumps(self.state_snapshot()))
+
+    def close_ops(self) -> None:
+        """Shut the ops listener down (tests / clean teardown)."""
+        if self.ops is not None:
+            self.ops.close()
 
     # -------------------------------------------------------- checkpoint pin
     def checkpoint_dirs(self, world_size: int) -> List[str]:
@@ -309,6 +402,14 @@ class DSElasticAgent:
                     env[var] = str(knob)
                 else:
                     env.pop(var, None)
+            # ops-plane exchange dir: workers publish per-rank metrics
+            # snapshots here for the agent's merged endpoint.  Same scrub
+            # hygiene as every env knob above — an inherited dir would feed
+            # this job's metrics into a FOREIGN aggregator as its ranks
+            if self._ops_dir is not None:
+                env[OPS_DIR_ENV] = self._ops_dir
+            else:
+                env.pop(OPS_DIR_ENV, None)
             if resume_tag is not None:
                 env[RESUME_TAG_ENV] = resume_tag
                 # scope the pin: tag names are the generic global_step<N>, so
@@ -462,9 +563,12 @@ class DSElasticAgent:
         self._install_signal_handlers()
         group: Optional[WorkerGroup] = None
         try:
+            self._current_world = world
             group = self._spawn(world)
             while True:
                 time.sleep(self.poll_interval)
+                # merged fleet metrics + liveness gauges each poll (host-only)
+                self._refresh_ops(group)
                 if self._interrupt_signum is not None:
                     signum = self._interrupt_signum
                     logger.warning(f"elastic agent: received signal {signum}; "
@@ -497,6 +601,13 @@ class DSElasticAgent:
                         logger.info("elastic agent: all workers finished cleanly")
                         self._record("run_complete", world=world,
                                      restarts=self.restart_count)
+                        self._refresh_ops(group, force=True)  # final merged view
+                        if self._ops_own_dir:
+                            # launcher convention: sweep OUR tempdir exchange
+                            # files on a clean run, keep them for postmortem
+                            # on any failure path; caller dirs never touched
+                            import shutil
+                            shutil.rmtree(self._ops_dir, ignore_errors=True)
                         return 0
                     else:
                         continue
@@ -515,6 +626,7 @@ class DSElasticAgent:
                                  reason="hang" if hung else "worker_failed")
                     world = shrunk
                 # world == min valid size: respawn at the same size
+                self._current_world = world
                 group = self._spawn(world)
         finally:
             self._restore_signal_handlers()

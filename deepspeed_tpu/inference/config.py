@@ -6,7 +6,8 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
-from ..runtime.config import (ServingFastpathConfig, ServingFaultToleranceConfig,
+from ..runtime.config import (OpsServerConfig, ServingFastpathConfig,
+                              ServingFaultToleranceConfig,
                               ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
@@ -54,6 +55,9 @@ class InferenceConfig(ConfigModel):
     # durable request journal + supervised restart / crash recovery —
     # inference/v2/journal.py + supervisor.py (same dual-spelling contract)
     serving_fault_tolerance: ServingFaultToleranceConfig = Field(ServingFaultToleranceConfig)
+    # pull-based ops endpoints (/metrics + /healthz + /statez) and per-rank
+    # metrics textfiles — monitor/ops_server.py (same dual-spelling contract)
+    ops_server: OpsServerConfig = Field(OpsServerConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

@@ -11,6 +11,7 @@ ragged kernels.
 """
 
 import inspect
+import json
 import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -24,7 +25,7 @@ from ...compat import shard_map
 from ...monitor.tracing import RequestTracer
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
-                                  NULL_HEARTBEAT, SERVING_FSYNC_ENV,
+                                  NULL_HEARTBEAT, OPS_DIR_ENV, SERVING_FSYNC_ENV,
                                   SERVING_GENERATION_ENV, SERVING_JOURNAL_ENV,
                                   HeartbeatWriter)
 from ...utils.env import env_float, env_int
@@ -101,6 +102,10 @@ class InferenceEngineV2:
         # drive a fake one), preemption policy shared with the scheduler
         self.resilience = self.config.serving_resilience
         self._clock = clock if clock is not None else time.monotonic
+        # an injected clock makes gauge timestamps deterministic too (ISSUE 11
+        # satellite): record_gauges stamps the engine clock's last donated
+        # read instead of wall time, so FakeClock tests assert exact stamps
+        self._clock_injected = clock is not None
         # request-lifecycle tracing (ISSUE 6): span chains per uid, SLO
         # latency histograms (TTFT/TBT/e2e/queue-wait), and the always-on
         # flight recorder — consumes ONLY the injectable clock, at points
@@ -116,7 +121,8 @@ class InferenceEngineV2:
         self.scheduler = SplitFuseScheduler(token_budget, max_seqs_per_step,
                                             telemetry=telemetry,
                                             resilience=self.resilience,
-                                            tracer=self.tracer)
+                                            tracer=self.tracer,
+                                            gauge_timestamp=self._gauge_timestamp)
         # serving fault tolerance (ISSUE 8): durable request journal + serve-
         # iteration liveness heartbeat.  Both arm from config OR the
         # ServingSupervisor's env exports (DSTPU_SERVING_JOURNAL +
@@ -155,6 +161,27 @@ class InferenceEngineV2:
         # supervisor stamps restarts_total/degraded onto each engine it builds
         self.ft_stats = {"restarts_total": 0, "recovered_requests_total": 0,
                          "degraded": False}
+        # pull-based ops plane (ISSUE 11): a /metrics + /healthz + /statez
+        # endpoint over host-side CACHED snapshots.  The serve loop refreshes
+        # the cache (throttled on the injectable clock) at host-touch points
+        # it already pays for; scrape handlers only read the cached strings,
+        # so a scrape can never trigger a device sync or race a step.  The
+        # supervisor-exported DSTPU_OPS_DIR additionally publishes per-rank
+        # snapshot/textfile pairs for fleet-level merging — honored ONLY
+        # under a serving supervisor (same gate as the heartbeat dir above:
+        # a serving engine inside a supervised TRAINING worker must not
+        # clobber the trainer's ops rank files).
+        self.ops_cfg = self.config.ops_server
+        ops_dir = (os.environ.get(OPS_DIR_ENV) if under_supervisor else None) \
+            or self.ops_cfg.textfile_dir
+        self._ops = None
+        if self.ops_cfg.enabled or ops_dir:
+            from ...monitor.ops_server import OpsPublisher
+            self._ops = OpsPublisher(self.ops_cfg, generation=generation,
+                                     ops_dir=ops_dir,
+                                     rank=int(os.environ.get("RANK", "0") or 0),
+                                     owner="serving engine")
+        self.ops = self._ops.server if self._ops is not None else None
         self.topology = topology
         self.tp = topology.axis_size(TENSOR_AXIS) if topology is not None else 1
         self._warn_truncated_nucleus()
@@ -193,6 +220,9 @@ class InferenceEngineV2:
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{block_size} "
                  f"budget={token_budget} dtype={self.config.dtype} tp={self.tp} "
                  f"fastpath={'on' if self.fastpath.enabled else 'off'}", ranks=[0])
+        # first ops snapshot at attach, so a scrape between construction and
+        # the first serve sees real (zeroed) families instead of an empty body
+        self.refresh_ops(force=True)
 
     def _warn_truncated_nucleus(self):
         """One-time runtime notice when TP candidate-set sampling approximates
@@ -514,6 +544,38 @@ class InferenceEngineV2:
         self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
 
+    def _gauge_timestamp(self) -> Optional[float]:
+        """Deterministic gauge timestamp when the engine runs on an injected
+        clock (FakeClock tests): the clock's last donated read.  None keeps
+        record_gauges' wall-clock default — unchanged production behavior."""
+        return self.tracer.last_now if self._clock_injected else None
+
+    # ---------------------------------------------------------- ops endpoints
+    def refresh_ops(self, force: bool = False) -> None:
+        """Refresh the host-side ops snapshots the scrape handlers serve:
+        re-populate the metrics registry from engine state (all python ints/
+        floats the host already owns — zero device syncs, dslint-enforced on
+        the whole ops plane), re-render the Prometheus text, re-dump
+        ``health()``/``state_snapshot()`` JSON, and republish the per-rank
+        exchange files when a supervisor exported ``DSTPU_OPS_DIR``.
+
+        Called from the serve loop (throttled on the injectable clock to one
+        refresh per ``ops_server.refresh_interval_s``) and force-called at
+        attach and serve end.  A no-op when the ops plane is off — the
+        byte-identical ServeCounters guarantee of the ops-smoke."""
+        if self._ops is None:
+            return
+        from ...monitor.metrics import populate_from_engine
+        self._ops.refresh(lambda reg: populate_from_engine(reg, self),
+                          now=self.tracer.last_now, force=force,
+                          healthz=lambda: json.dumps(self.health()),
+                          statez=lambda: json.dumps(self.state_snapshot()))
+
+    def close_ops(self) -> None:
+        """Shut the ops HTTP listener down (tests / clean teardown)."""
+        if self._ops is not None:
+            self._ops.close()
+
     def _emit_serving_gauges(self, tokens_run: int) -> None:
         """Serving rates on top of the scheduler's per-step gauges: requests/s
         (retired-sequence rate) and tokens/s through the ragged forward."""
@@ -549,7 +611,8 @@ class InferenceEngineV2:
         if tps is not None:
             gauges["tokens_per_sec"] = tps
         self.telemetry.record_gauges(gauges, step=self.scheduler.steps,
-                                     prefix="Inference/Serving")
+                                     prefix="Inference/Serving",
+                                     timestamp=self._gauge_timestamp())
 
     def _compiled_step_pick(self, n: int, greedy: bool):
         key = ("pick", n, greedy, self.config.temperature, self.config.top_k,
@@ -916,6 +979,9 @@ class InferenceEngineV2:
                 # buffered token deltas must not outlive the call that
                 # materialized them (a strict raise included)
                 self.journal.flush()
+            # final ops snapshot: a post-serve scrape must see the completed
+            # state (lifetime counters, emptied queue), not a mid-wave cache
+            self.refresh_ops(force=True)
         return results
 
     def _serve_loop(self, uids: List[int], my: set, results: Dict[int, RequestResult],
@@ -943,6 +1009,9 @@ class InferenceEngineV2:
             # host-owned ints only — the supervisor reads staleness as a hang.
             # Throttled inside the writer; NULL writer when supervision is off
             self._heartbeat.stamp(self.counters.loop_iterations, phase="serving")
+            # ops-plane cache refresh (ISSUE 11): host-only snapshot rebuild,
+            # throttled on the injectable clock; a no-op with the plane off
+            self.refresh_ops()
             if self._inflight is not None and (len(self.admission)
                                                or self._any_live_deadline()):
                 # wave boundary: admission/deadline handling below may evict

@@ -36,7 +36,7 @@ class SplitFuseScheduler:
 
     def __init__(self, token_budget: int = 512, max_seqs_per_step: int = 64,
                  telemetry=None, resilience: Optional[ServingResilienceConfig] = None,
-                 tracer=None):
+                 tracer=None, gauge_timestamp=None):
         self.token_budget = token_budget
         self.max_seqs = max_seqs_per_step
         # TelemetryCollector (monitor/telemetry.py); every schedule() emits
@@ -45,6 +45,10 @@ class SplitFuseScheduler:
         # RequestTracer (monitor/tracing.py): preempt/requeue land in the
         # victim's span chain and the always-on flight recorder (ISSUE 6)
         self.tracer = tracer
+        # engine-provided deterministic gauge timestamp (None -> wall clock):
+        # the engine returns its injected clock's last read under FakeClock
+        # tests so scheduler gauge records stamp deterministically too
+        self.gauge_timestamp = gauge_timestamp
         self.resilience = resilience if resilience is not None else ServingResilienceConfig()
         self.steps = 0
         self.preempted_total = 0
@@ -194,8 +198,9 @@ class SplitFuseScheduler:
         }
         self.steps += 1
         if self.telemetry is not None:
-            self.telemetry.record_gauges(self.last_gauges, step=self.steps,
-                                         prefix="Inference/Scheduler")
+            self.telemetry.record_gauges(
+                self.last_gauges, step=self.steps, prefix="Inference/Scheduler",
+                timestamp=self.gauge_timestamp() if self.gauge_timestamp else None)
 
     def _reserve(self, manager: RaggedStateManager, seq: SequenceDescriptor, n: int) -> bool:
         self._reserve_faulted = False

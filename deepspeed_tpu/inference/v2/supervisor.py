@@ -39,6 +39,7 @@ fault tests drive fake time deterministically.
 """
 
 import dataclasses
+import json
 import os
 import shutil
 import subprocess
@@ -48,11 +49,12 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...monitor.tracing import FlightRecorder
-from ...runtime.config import ServingFaultToleranceConfig
+from ...runtime.config import OpsServerConfig, ServingFaultToleranceConfig
 from ...runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
-                                  SERVING_DRAIN_ENV, SERVING_FSYNC_ENV,
-                                  SERVING_GENERATION_ENV, SERVING_JOURNAL_ENV,
-                                  heartbeat_age, read_heartbeats)
+                                  OPS_DIR_ENV, SERVING_DRAIN_ENV,
+                                  SERVING_FSYNC_ENV, SERVING_GENERATION_ENV,
+                                  SERVING_JOURNAL_ENV, heartbeat_age,
+                                  read_heartbeats)
 from ...utils.logging import logger
 from .admission import (DEADLINE_EXPIRED, FAILED, OK, SHED, RecoveredRequest,
                         RequestResult)
@@ -240,7 +242,8 @@ class ServingSupervisor:
                  journal_path: Optional[str] = None, config=None,
                  telemetry=None, clock: Callable[[], float] = time.monotonic,
                  wall_clock: Callable[[], float] = time.time,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 ops_server=None):
         if config is None:
             config = ServingFaultToleranceConfig(enabled=False)
         elif isinstance(config, dict):
@@ -262,6 +265,109 @@ class ServingSupervisor:
         self._failure_times: deque = deque()
         # the supervisor's own postmortem ring, mirroring the elastic agent's
         self.recorder = FlightRecorder(256)
+        # fleet-level ops endpoint (ISSUE 11): workers publish per-generation
+        # registry snapshots (env-armed via DSTPU_OPS_DIR in subprocess mode;
+        # absorbed directly from the engine in-process), and the aggregator
+        # merges them — histograms via StreamingHistogram.merge, counters
+        # carried across generations so a restart never makes a fleet
+        # counter jump backwards.  `ops_server` is an OpsServerConfig/dict;
+        # None leaves the plane off.
+        self.ops_cfg: Optional[OpsServerConfig] = None
+        self.ops = None
+        self._ops_cache = None
+        self._ops_agg = None
+        self._ops_dir: Optional[str] = None
+        self._ops_own_dir = False
+        if ops_server is not None:
+            cfg = ops_server if isinstance(ops_server, OpsServerConfig) \
+                else OpsServerConfig(**dict(ops_server))
+            if cfg.enabled or cfg.textfile_dir:
+                from ...monitor.metrics import FleetAggregator
+                from ...monitor.ops_server import OpsCache, try_start_ops_server
+                self.ops_cfg = cfg
+                self._ops_agg = FleetAggregator()
+                self._ops_cache = OpsCache()
+                self._ops_dir = cfg.textfile_dir
+                if self._ops_dir is None:
+                    self._ops_dir = tempfile.mkdtemp(prefix="dstpu_serving_ops_")
+                    self._ops_own_dir = True
+                if cfg.enabled:
+                    self.ops = try_start_ops_server(self._ops_cache,
+                                                    host=cfg.host, port=cfg.port,
+                                                    owner="serving supervisor")
+                self._ops_last_refresh = -float("inf")
+                self._refresh_ops(force=True)
+
+    # ----------------------------------------------------------- ops endpoint
+    def ops_health(self) -> Dict[str, Any]:
+        """The supervisor's /healthz: restart budget, degradation, and which
+        worker ranks have published metrics — the router's admit signal."""
+        return {
+            "restarts_total": self.restarts_total,
+            "generations": self.generations,
+            "degraded": self.degraded,
+            "recovered_requests_total": self.recovered_requests_total,
+            "failures_in_window": len(self._failure_times),
+            "max_restarts": self.cfg.max_restarts,
+            "ranks": self._ops_agg.ranks() if self._ops_agg is not None else [],
+        }
+
+    def _refresh_ops(self, force: bool = False) -> None:
+        """Absorb fresh worker snapshots and re-render the merged fleet
+        registry + supervisor health into the scrape cache (owning-thread
+        only; host values only).  The whole pass — dir scan, snapshot
+        parses, render — sits behind one throttle of ``refresh_interval_s``:
+        the watch loop polls every ``poll_interval_s`` (20x/s by default)
+        and must not pay it on every tick."""
+        if self._ops_agg is None:
+            return
+        now = self._clock()
+        if not force and now - self._ops_last_refresh < self.ops_cfg.refresh_interval_s:
+            return
+        self._ops_last_refresh = now
+        self._ops_absorb_dir()
+        from ...monitor.exposition import render
+        from ...monitor.metrics import populate_from_supervisor
+        merged = self._ops_agg.registry(namespace=self.ops_cfg.namespace)
+        populate_from_supervisor(merged, self)
+        self._ops_cache.update(
+            metrics_text=render(merged, collect=False),
+            healthz=json.dumps(self.ops_health()),
+            statez=json.dumps({"events": self.recorder.tail(),
+                               "ranks": self._ops_agg.ranks()}))
+
+    def _ops_absorb_dir(self) -> None:
+        """Fold every readable worker snapshot under the ops dir into the
+        aggregator (subprocess mode; generation bumps roll counter carry)."""
+        if self._ops_agg is None or self._ops_dir is None:
+            return
+        from ...monitor.ops_server import read_rank_snapshots
+        from ...utils.logging import warning_once
+        for rank, snap in read_rank_snapshots(self._ops_dir).items():
+            try:
+                self._ops_agg.absorb(rank, snap)
+            except (ValueError, KeyError, TypeError) as exc:
+                # a malformed-but-parseable snapshot degrades that rank's
+                # freshness; it must never unwind the watch loop that every
+                # worker's kill-and-reap lifecycle hangs off
+                warning_once(f"ops: rank {rank} snapshot rejected ({exc!r}); "
+                             f"keeping its last merged state")
+
+    def _ops_absorb_engine(self, engine, generation: int) -> None:
+        """Fold an in-process engine's final state into the aggregator (the
+        in-process analog of a worker's published snapshot)."""
+        if self._ops_agg is None or engine is None:
+            return
+        from ...monitor.metrics import MetricsRegistry, populate_from_engine
+        reg = MetricsRegistry(namespace=self.ops_cfg.namespace,
+                              generation=generation)
+        populate_from_engine(reg, engine)
+        self._ops_agg.absorb(0, reg.snapshot())
+
+    def close_ops(self) -> None:
+        """Shut the ops listener down (tests / clean teardown)."""
+        if self.ops is not None:
+            self.ops.close()
 
     # ------------------------------------------------------------- accounting
     def _event(self, event: str, **fields) -> None:
@@ -369,6 +475,13 @@ class ServingSupervisor:
                 self.generations = generation + 1
                 if engine is not None and engine.journal is not None:
                     engine.journal.close()
+                # ops aggregation (ISSUE 11): this generation's final engine
+                # state joins the fleet view; a crash resets the NEXT
+                # generation's counters to zero, which the aggregator's
+                # generation carry absorbs — the merged endpoint stays
+                # monotone across the restart
+                self._ops_absorb_engine(engine, generation)
+                self._refresh_ops(force=True)
             generation += 1
         return [results[u] for u in uid_list]
 
@@ -435,6 +548,15 @@ class ServingSupervisor:
             worker_env[HEARTBEAT_DIR_ENV] = hb_dir
             worker_env[HEARTBEAT_INTERVAL_ENV] = str(cfg.heartbeat_interval_s)
             worker_env[SERVING_GENERATION_ENV] = str(generation)
+            if self._ops_dir is not None:
+                # workers publish per-rank registry snapshots the aggregator
+                # merges into the fleet endpoint (generation-stamped, so the
+                # counter carry engages across restarts)
+                worker_env[OPS_DIR_ENV] = self._ops_dir
+            else:
+                # scrub an inherited dir: a foreign supervisor's aggregator
+                # must not absorb THIS worker's snapshots as one of its ranks
+                worker_env.pop(OPS_DIR_ENV, None)
             if drain:
                 worker_env[SERVING_DRAIN_ENV] = "1"
             else:
@@ -461,14 +583,20 @@ class ServingSupervisor:
                 self._event("degraded", reason="restart budget exhausted",
                             restarts=self.restarts_total)
             generation += 1
+        # final aggregation pass BEFORE any cleanup, and AFTER the recovery
+        # accounting below lands — the endpoint's restarts/recovered counters
+        # must describe the finished run, not the pre-run state
+        state = replay_journal(self.journal_path, truncate=True)
+        self.recovered_requests_total = sum(
+            1 for e in state.entries.values() if e.admits > 1)
+        self._refresh_ops(force=True)
         if own_hb_base and clean_exit:
             # launcher convention (run_elastic): sweep OUR tempdir stamps on
             # a clean run, keep them for postmortem on any failure path;
             # caller-provided dirs are never touched
             shutil.rmtree(hb_base, ignore_errors=True)
-        state = replay_journal(self.journal_path, truncate=True)
-        self.recovered_requests_total = sum(
-            1 for e in state.entries.values() if e.admits > 1)
+        if self._ops_own_dir and clean_exit:
+            shutil.rmtree(self._ops_dir, ignore_errors=True)
         return {"generations": self.generations,
                 "restarts": self.restarts_total,
                 "degraded": self.degraded,
@@ -490,6 +618,10 @@ class ServingSupervisor:
                     return None
                 failure = f"worker exited rc={rc}"
                 break
+            # fold any fresh worker metrics into the fleet endpoint (the
+            # dir scan + render ride _refresh_ops' throttle, not the poll
+            # rate) — scrapes mid-generation see live cached numbers
+            self._refresh_ops()
             record = read_heartbeats(hb_dir).get(0)
             if record is None:
                 if self._clock() - start > cfg.startup_grace_s:

@@ -304,6 +304,10 @@ def run_elastic(args) -> int:
                       heartbeat_timeout_s=args.heartbeat_timeout)
     if args.collective_timeout is not None:
         kwargs.update(collective_timeout_s=args.collective_timeout)
+    if args.ops_port is not None:
+        # merged fleet /metrics + /healthz over the agent (workers publish
+        # per-rank snapshots via the DSTPU_OPS_DIR export; monitor/ops_server)
+        kwargs.update(ops_port=args.ops_port)
     agent = DSElasticAgent(
         [sys.executable, "-u", args.user_script] + list(args.user_args),
         world_size=args.elastic, elastic_config=elastic_config,
@@ -357,6 +361,13 @@ def main(argv=None):
     parser.add_argument("--heartbeat_timeout", type=float, default=None,
                         help="with --elastic: a rank whose heartbeat stamp is older "
                              "than this many seconds is treated as hung")
+    parser.add_argument("--ops_port", type=int, default=None, metavar="PORT",
+                        help="with --elastic: serve merged fleet metrics + health "
+                             "on this port (Prometheus /metrics, JSON /healthz and "
+                             "/statez; 0 picks an ephemeral port).  Workers publish "
+                             "per-rank snapshots via the agent-exported "
+                             "DSTPU_OPS_DIR; counters stay monotone across worker "
+                             "restarts")
     parser.add_argument("--collective_timeout", type=float, default=None,
                         help="with --elastic: wall-clock bound (seconds) exported to "
                              "workers (DSTPU_COLLECTIVE_TIMEOUT_S) so a wedged host "

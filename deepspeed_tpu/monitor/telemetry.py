@@ -99,6 +99,14 @@ class TelemetryCollector:
         self.records_written = 0
         # requests/sec rate tracking for serving gauges (name -> (t, count))
         self._rates: Dict[str, Tuple[float, float]] = {}
+        # host-side caches for the pull-based ops plane (monitor/metrics.py
+        # populate_from_telemetry): the newest train-step record, the newest
+        # gauges per prefix, and lifetime resilience-event counts — reading
+        # them re-reads values this collector already assembled, so an ops
+        # refresh can never trigger a device sync
+        self.last_train_record: Optional[Dict[str, Any]] = None
+        self.last_gauges: Dict[str, Dict[str, Any]] = {}
+        self.resilience_counts: Dict[str, int] = {}
 
     # ------------------------------------------------------------- flops / mfu
     def wants_flops(self) -> bool:
@@ -152,19 +160,28 @@ class TelemetryCollector:
         }
         if extra:
             record.update(extra)
+        self.last_train_record = record
         self._write_jsonl(record)
         return record
 
     def record_gauges(self, gauges: Dict[str, Any], step: int,
-                      prefix: str = "Inference") -> Optional[Dict[str, Any]]:
+                      prefix: str = "Inference",
+                      timestamp: Optional[float] = None) -> Optional[Dict[str, Any]]:
         """Point-in-time gauges (scheduler/serving state) → monitor events and
-        a ``kind: gauges`` JSONL record."""
+        a ``kind: gauges`` JSONL record.  ``timestamp`` lets a caller on an
+        injectable clock (the v2 serving engine under a FakeClock) stamp the
+        record deterministically; None keeps the wall-clock default."""
         if not self.enabled:
             return None
         self.record_events([(f"{prefix}/{k}", float(v), int(step))
                             for k, v in gauges.items() if v is not None])
         record = {"kind": "gauges", "prefix": prefix, "step": int(step),
-                  "timestamp": time.time(), **gauges}
+                  "timestamp": time.time() if timestamp is None else float(timestamp),
+                  **gauges}
+        # cache the GAUGES only, not the whole record — the ops adapter
+        # exports every numeric cached key as a metric family, and the
+        # record's step/timestamp bookkeeping must not become one
+        self.last_gauges[prefix] = dict(gauges)
         self._write_jsonl(record)
         return record
 
@@ -178,6 +195,7 @@ class TelemetryCollector:
             return None
         record = {"kind": "resilience", "event": event, "step": int(step),
                   "timestamp": time.time(), **fields}
+        self.resilience_counts[event] = self.resilience_counts.get(event, 0) + 1
         self._write_jsonl(record)
         self.record_events([(f"Resilience/{event}/{k}", float(v), int(samples))
                             for k, v in fields.items()

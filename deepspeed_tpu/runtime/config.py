@@ -595,6 +595,37 @@ class ServingFaultToleranceConfig(ConfigModel):
                              "which exports DSTPU_HEARTBEAT_DIR)")
 
 
+class OpsServerConfig(ConfigModel):
+    """Pull-based ops endpoints (monitor/metrics.py + monitor/ops_server.py —
+    the PULL counterpart of the reference's push-only ``monitor/`` backends:
+    a Prometheus ``/metrics`` endpoint plus JSON ``/healthz``/``/statez``
+    probes over everything PRs 1-8 measure).
+
+    ``enabled`` starts a stdlib ``ThreadingHTTPServer`` on ``host:port``
+    (``port=0`` = ephemeral; read it from the attach point's ``.ops.port``)
+    serving ONLY host-side cached snapshots — the owning loop refreshes the
+    cache at host-touch points it already pays for, throttled to one refresh
+    per ``refresh_interval_s``, so a scrape can never trigger a device sync
+    or race a mutating step (dslint's host-sync rule scans the whole ops
+    plane).  The serving engine refreshes on its injectable clock; training
+    refreshes at the telemetry record boundary.
+
+    ``textfile_dir`` additionally publishes this process's registry as
+    atomic per-rank files (``ops.rank<R>.json`` exact-merge snapshot +
+    ``ops.rank<R>.prom`` rendered textfile).  The elastic agent and the
+    ``ServingSupervisor`` export ``DSTPU_OPS_DIR`` to their workers (the
+    heartbeat env contract) and merge the snapshots into one fleet-level
+    endpoint whose counters stay monotone across worker restarts; the env
+    wins over this field, so supervised workers need no config changes.
+    """
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = Field(0, ge=0, le=65535)  # 0 => ephemeral
+    refresh_interval_s: float = Field(0.25, ge=0.0)
+    textfile_dir: Optional[str] = None
+    namespace: str = "dstpu"
+
+
 class NebulaConfig(ConfigModel):
     """Reference: top-level "nebula" section (nebula/config.py) — enabling it
     selects the async (background-writer) checkpoint engine."""
@@ -713,6 +744,9 @@ class TrainingConfig(ConfigModel):
     # serving crash durability (request journal) + supervised restart —
     # same dual-spelling contract as above
     serving_fault_tolerance: ServingFaultToleranceConfig = Field(ServingFaultToleranceConfig)
+    # pull-based ops endpoints (/metrics Prometheus exposition + /healthz +
+    # /statez) and per-rank metrics textfiles — same dual-spelling contract
+    ops_server: OpsServerConfig = Field(OpsServerConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -15,6 +15,7 @@ replicated so XLA gathers the half-size copy (the analog of allgathering updated
 bit16 partitions after the sharded step, stage_1_and_2.py:1786).
 """
 
+import json
 import os
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -35,7 +36,7 @@ from . import lr_schedules, optimizers
 from .checkpointing import (CheckpointError, _is_rank0, find_latest_valid_tag,
                             load_checkpoint_dir, save_checkpoint_with_retries,
                             sweep_retention, validate_checkpoint_tag)
-from .heartbeat import build_heartbeat
+from .heartbeat import OPS_DIR_ENV, build_heartbeat
 from .grad_accum import accumulate_micro_grads
 from .config import TrainingConfig, load_config
 from .optimizers import (LossScaleState, clip_by_global_norm, global_grad_norm, has_overflow, init_loss_scale,
@@ -166,9 +167,41 @@ class Engine:
         # engine (None resets to unbounded, the historical behavior)
         from ..comm import comm as _dist
         _dist.set_default_collective_timeout(config.fault_tolerance.collective_timeout_s)
+        # pull-based ops plane (ISSUE 11): rank 0 serves /metrics (Prometheus
+        # text over the telemetry collector's cached records) + /healthz +
+        # /statez; every rank publishes per-rank snapshot/textfiles when the
+        # elastic agent exported DSTPU_OPS_DIR (or ops_server.textfile_dir is
+        # set), which the agent merges into one fleet endpoint.  The cache
+        # refreshes at the train-step telemetry boundary — host values only
+        self._ops = None
+        self._ops_cfg = config.ops_server
+        self._ops_rank = int(os.environ.get("RANK", "0") or 0)
+        ops_dir = os.environ.get(OPS_DIR_ENV) or self._ops_cfg.textfile_dir
+        if self._ops_cfg.enabled or ops_dir:
+            from ..monitor.ops_server import OpsPublisher
+            from .config import OpsServerConfig
+            cfg = self._ops_cfg
+            if cfg.enabled and not self.telemetry._is_rank0:
+                # one endpoint per job: ranks > 0 publish exchange files only
+                # (the agent merges them); a per-rank listener would fight
+                # over the configured port across processes
+                cfg = OpsServerConfig(enabled=False, host=cfg.host,
+                                      refresh_interval_s=cfg.refresh_interval_s,
+                                      textfile_dir=cfg.textfile_dir,
+                                      namespace=cfg.namespace)
+            self._ops = OpsPublisher(
+                cfg,
+                generation=int(os.environ.get("DSTPU_ELASTIC_RESTART", "0") or 0),
+                ops_dir=ops_dir, rank=self._ops_rank, owner="training engine")
+        self.ops = self._ops.server if self._ops is not None else None
         self.throughput = ThroughputTimer(batch_size=self.train_batch_size)
         self.global_steps = 0
         self.global_samples = 0
+        # per-process counter bases for the ops plane: load_checkpoint moves
+        # them to the restored position so exported counters stay
+        # this-process-only (see _populate_ops_registry)
+        self._ops_steps_base = 0
+        self._ops_samples_base = 0
         self._micro_batches: list = []
         self._compiled_step = None
         self._compiled_eval = None
@@ -248,6 +281,11 @@ class Engine:
             f"batch={self.train_batch_size} (micro={self.micro_batch_size} x gas="
             f"{self.gradient_accumulation_steps} x dp={self.dp_world_size}) "
             f"dtype={self.compute_dtype.__name__} params={n_params/1e6:.2f}M", ranks=[0])
+        # first ops snapshot at attach: a scrape during the (possibly long)
+        # jit-compile window before step 1 must see real zeroed families and
+        # a populated /healthz, not the cache's empty defaults — the same
+        # contract the serving engine's attach-time refresh keeps
+        self._refresh_ops(force=True)
 
     # ------------------------------------------------------------------ init
     def _init_state(self, params) -> TrainState:
@@ -691,6 +729,73 @@ class Engine:
         sharding = NamedSharding(self.topology.mesh, PartitionSpec(None, axes))
         return jax.tree_util.tree_map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
+    # ------------------------------------------------------------ ops plane
+    def ops_health(self) -> Dict[str, Any]:
+        """The training engine's /healthz payload: host-owned progress and
+        liveness state plus the newest telemetry record's headline numbers
+        (all cached — reading this can never touch a device value)."""
+        record = self._last_telemetry_record or {}
+        return {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "consecutive_bad_steps": self._consecutive_bad_steps,
+            "heartbeat": bool(getattr(self.heartbeat, "enabled", False)),
+            "rank": self._ops_rank,
+            "loss": record.get("loss"),
+            "step_time_ms": record.get("step_time_ms"),
+            "samples_per_sec": record.get("samples_per_sec"),
+            "tokens_per_sec": record.get("tokens_per_sec"),
+            "mfu": record.get("mfu"),
+        }
+
+    def _refresh_ops(self, force: bool = False) -> None:
+        """Refresh the cached ops snapshots at the train-step boundary
+        (throttled to ``ops_server.refresh_interval_s``): registry from the
+        engine's host counters + the telemetry caches, /healthz JSON, and the
+        per-rank exchange files under the agent-exported ops dir.  A no-op
+        when the ops plane is off.  A checkpoint rollback (load_checkpoint
+        after the NaN watchdog) legally rewinds global_steps; the publisher
+        exposes that as a standard Prometheus counter reset (OpsPublisher
+        docstring) instead of raising into train_batch."""
+        if self._ops is None:
+            return
+        self._ops.refresh(
+            self._populate_ops_registry, now=time.monotonic(), force=force,
+            healthz=lambda: json.dumps(self.ops_health()),
+            statez=lambda: json.dumps(self._ops.registry.snapshot()))
+
+    def _populate_ops_registry(self, reg) -> None:
+        from ..monitor.metrics import populate_from_telemetry
+        ns = reg.namespace
+        # telemetry first, engine families second: both spell the
+        # global-step/samples gauges, and after a checkpoint rollback the
+        # collector's cached record is stale — the engine's live position
+        # must win the overwrite
+        populate_from_telemetry(reg, self.telemetry)
+        # counters are THIS PROCESS's work (steps/samples since the last
+        # checkpoint load): a resumed engine restarts them from zero so the
+        # fleet aggregator's generation carry — which folds the previous
+        # life's totals — never double-counts the resumed prefix.  The
+        # absolute training position rides as a gauge.
+        reg.set_counter(f"{ns}_train_steps_total",
+                        self.global_steps - self._ops_steps_base,
+                        help_text="optimizer steps run by this process")
+        reg.set_counter(f"{ns}_train_samples_total",
+                        self.global_samples - self._ops_samples_base,
+                        help_text="samples consumed by this process")
+        reg.set_gauge(f"{ns}_train_global_step", self.global_steps,
+                      help_text="absolute training step (checkpoint position)")
+        reg.set_gauge(f"{ns}_train_global_samples", self.global_samples,
+                      help_text="absolute samples consumed (checkpoint position)")
+        reg.set_gauge(f"{ns}_train_consecutive_bad_steps",
+                      self._consecutive_bad_steps,
+                      help_text="current NaN/overflow watchdog streak")
+
+    def close_ops(self) -> None:
+        """Shut the ops HTTP listener down (tests / clean teardown)."""
+        if self._ops is not None:
+            self._ops.close()
+
     def train_batch(self, batch):
         """Run one full optimizer step on a global macro-batch.
 
@@ -722,6 +827,7 @@ class Engine:
                     step=self.global_steps, samples=self.global_samples,
                     loss=loss, grad_norm=0.0, lr=lr, step_time_s=step_time,
                     tokens=self._batch_tokens(batch, seq_dim=1))
+            self._refresh_ops()
             self._watchdog_check(metrics, loss_val=loss)
             self._maybe_report(metrics)
             return metrics
@@ -798,6 +904,9 @@ class Engine:
             # memory_breakdown stands alone: the reference's top-level key must
             # snapshot even when per-step telemetry records are off
             see_memory_usage(f"after train step {self.global_steps}")
+        # ops-plane cache refresh (ISSUE 11): host-only, after the telemetry
+        # record so a scrape sees THIS step; throttled; no-op when off
+        self._refresh_ops()
         self._watchdog_check(metrics, loss_val=loss_val)
         self._maybe_report(metrics, loss=loss_val)
         return metrics
@@ -1157,6 +1266,15 @@ class Engine:
                     self.state = state
                     self.global_steps = client_state.get("global_steps", 0)
                     self.global_samples = client_state.get("global_samples", 0)
+                    # ops-plane counter base: the restored steps/samples were
+                    # executed by a PREVIOUS process life (the fleet
+                    # aggregator carries that life's totals), so this
+                    # process's exported counters restart from zero here —
+                    # without this, every supervised restart that resumes
+                    # from a checkpoint double-counts the resumed work in
+                    # the merged fleet endpoint
+                    self._ops_steps_base = self.global_steps
+                    self._ops_samples_base = self.global_samples
                     if "lr_scheduler" in client_state:
                         self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
                     out = (tag, client_state)

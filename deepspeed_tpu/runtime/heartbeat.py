@@ -70,6 +70,12 @@ SERVING_JOURNAL_ENV = "DSTPU_SERVING_JOURNAL"
 SERVING_FSYNC_ENV = "DSTPU_SERVING_FSYNC_EVERY"
 SERVING_GENERATION_ENV = "DSTPU_SERVING_GENERATION"
 SERVING_DRAIN_ENV = "DSTPU_SERVING_DRAIN"
+# ops-plane exchange dir (monitor/ops_server.py): the elastic agent and the
+# ServingSupervisor export it so every supervised worker publishes per-rank
+# metrics snapshots/textfiles the supervisor merges into one fleet endpoint
+# (env wins over the ops_server.textfile_dir config, same as the rest of the
+# contract above)
+OPS_DIR_ENV = "DSTPU_OPS_DIR"
 _FILE_PREFIX = "hb.rank"
 
 

@@ -105,9 +105,11 @@ class HostSyncInHotPath(Rule):
                    "under inference/v2/ any direct np.asarray/np.array/"
                    "device_get/block_until_ready outside the sanctioned "
                    "fastpath.materialize() deferred-sync helper; in "
-                   "runtime/heartbeat.py any explicit device fetch "
-                   "(np.asarray/np.array/device_get/block_until_ready/.item) "
-                   "anywhere in the file — liveness stamps are contractually "
+                   "runtime/heartbeat.py AND the ops plane (monitor/metrics.py, "
+                   "monitor/exposition.py, monitor/ops_server.py) any explicit "
+                   "device fetch (np.asarray/np.array/device_get/"
+                   "block_until_ready/.item) anywhere in the file — liveness "
+                   "stamps and metrics scrapes are contractually "
                    "zero-device-sync (float() on host config values stays "
                    "legal there; float-of-device-value isn't statically "
                    "separable from it)")
@@ -127,6 +129,13 @@ class HostSyncInHotPath(Rule):
     # owns, so the WHOLE file is scanned (module level included) with the
     # full sync set, not just the hot-path function names
     HEARTBEAT_PATH_FRAGMENT = "runtime/heartbeat.py"
+    # the ops plane inherits the same whole-file contract (ISSUE 11): a
+    # scrape handler or registry adapter that fetches a device value turns
+    # every Prometheus poll into a hidden device stall — these modules read
+    # only host-side cached snapshots, and a fetch sneaking in is a lint
+    # error, not a scrape-time surprise
+    OPS_PATH_FRAGMENTS = ("monitor/metrics.py", "monitor/exposition.py",
+                          "monitor/ops_server.py")
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -140,7 +149,19 @@ class HostSyncInHotPath(Rule):
         jit_roots = ctx.jit_roots(module)
         relpath = module.relpath.replace("\\", "/")
         if relpath.endswith(self.HEARTBEAT_PATH_FRAGMENT):
-            yield from self._check_heartbeat_file(module, jit_roots)
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in runtime/heartbeat.py — heartbeat stamps are contractually "
+                "zero-device-sync (they run in the train hot loop); stamp only "
+                "host-native values")
+            return
+        if any(relpath.endswith(f) for f in self.OPS_PATH_FRAGMENTS):
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in the ops plane (monitor/metrics|exposition|ops_server) — "
+                "scrape handlers and registry adapters are contractually "
+                "zero-device-sync: they read host-side cached snapshots only, "
+                "or every Prometheus poll becomes a hidden device stall")
             return
         in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent
@@ -178,11 +199,11 @@ class HostSyncInHotPath(Rule):
                                        "helper or suppress with a reason if this is "
                                        "host-only data")
 
-    def _check_heartbeat_file(self, module, jit_roots) -> Iterator[Finding]:
-        """Whole-file scan of runtime/heartbeat.py with the full sync set:
-        stamps run inside the train hot loop, so a sync sneaking into ANY
-        helper here becomes a silent per-step stall — flag it everywhere,
-        module level included."""
+    def _check_zero_sync_file(self, module, jit_roots, suffix: str) -> Iterator[Finding]:
+        """Whole-file scan with the full explicit-fetch set (heartbeat seam
+        and the ops plane): these modules run inside hot loops or behind
+        scrape endpoints, so a sync sneaking into ANY helper becomes a silent
+        recurring stall — flag it everywhere, module level included."""
         for sub in _walk_skipping(module.tree, set(jit_roots)):
             if not isinstance(sub, ast.Call):
                 continue
@@ -193,10 +214,7 @@ class HostSyncInHotPath(Rule):
                     sub.func.attr == "item":
                 msg = ".item() forces a device value to host"
             if msg:
-                yield self.finding(module, sub, msg + " in runtime/heartbeat.py "
-                                   "— heartbeat stamps are contractually "
-                                   "zero-device-sync (they run in the train hot "
-                                   "loop); stamp only host-native values")
+                yield self.finding(module, sub, msg + suffix)
 
     def _sync_call(self, call: ast.Call) -> Optional[str]:
         f = call.func

@@ -337,6 +337,107 @@ def tracing_smoke():
     return 0
 
 
+def ops_smoke():
+    """CI smoke for the ops plane (ISSUE 11 acceptance): a mixed-arrival
+    serve with the ops server ON must (a) answer /metrics scrapes MID-SERVE
+    and after with valid Prometheus 0.0.4 text (validated by the in-tree
+    strict parser) exposing the shed/preempt/fastpath counters and the
+    TTFT/TBT/e2e histograms, (b) mirror ``health()`` on /healthz, and
+    (c) add ZERO host-link cost — the fastpath ``ServeCounters`` snapshots
+    are byte-identical with the server on vs off, and the tokens match
+    (the same guarantee style as the tracing/journal smokes)."""
+    import os
+    import threading
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.ops_server import scrape
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, int(n)).tolist() for n in rng.integers(4, 16, 6)]
+
+    on = InferenceEngineV2(llama, cfg, params,
+                           config={"dtype": "float32",
+                                   "serving_tracing": {"enabled": True},
+                                   "ops_server": {"enabled": True,
+                                                  "refresh_interval_s": 0.0}},
+                           **kw)
+    off = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32",
+                                    "serving_tracing": {"enabled": True}}, **kw)
+    url = on.ops.url
+
+    # ---- (a) mid-serve scrapes from a concurrent thread: every response
+    # must strict-parse; the handler serves cached strings, so a scrape can
+    # never sync a device or race the loop
+    mid_serve = {"metrics": 0, "healthz": 0, "errors": []}
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                parse_exposition(scrape(url("/metrics")))
+                mid_serve["metrics"] += 1
+                json.loads(scrape(url("/healthz")))
+                mid_serve["healthz"] += 1
+            except Exception as exc:  # a single bad payload fails the smoke
+                mid_serve["errors"].append(repr(exc))
+                return
+
+    thread = threading.Thread(target=scraper, daemon=True)
+    thread.start()
+    out_on = on.generate(prompts, max_new_tokens=8)
+    stop.set()
+    thread.join(timeout=10.0)
+    assert not mid_serve["errors"], f"mid-serve scrape failed: {mid_serve['errors']}"
+    assert mid_serve["metrics"] > 0, "no successful mid-serve scrape"
+
+    # ---- post-serve: the acceptance families with correct values
+    body = scrape(url("/metrics"))
+    fams = parse_exposition(body)
+    counter = lambda name: fams[name]["samples"][0][2]
+    assert counter("dstpu_serving_shed_total") == on.admission.shed_total
+    assert counter("dstpu_serving_preempted_total") == on.scheduler.preempted_total
+    assert counter("dstpu_serving_completed_total") == len(prompts)
+    assert counter("dstpu_fastpath_host_syncs_total") == on.counters.host_syncs
+    for name in ("dstpu_request_ttft_seconds", "dstpu_request_tbt_seconds",
+                 "dstpu_request_e2e_seconds"):
+        assert fams[name]["type"] == "histogram"
+        bucket_inf = [v for n, l, v in fams[name]["samples"]
+                      if n.endswith("_bucket") and l.get("le") == "+Inf"]
+        assert bucket_inf and bucket_inf[0] > 0, f"{name} histogram is empty"
+    health = json.loads(scrape(url("/healthz")))
+    assert health == json.loads(json.dumps(on.health())), \
+        "/healthz does not mirror health()"
+    statez = json.loads(scrape(url("/statez")))
+    assert statez["flight_recorder"], "statez missing the flight-recorder tail"
+
+    # ---- (c) zero added host-link cost: counters byte-identical on vs off
+    out_off = off.generate(prompts, max_new_tokens=8)
+    assert out_on == out_off, "ops server changed the served tokens"
+    c_on, c_off = on.counters.snapshot(), off.counters.snapshot()
+    assert c_on == c_off, \
+        f"ops server disturbed the host-link counters: {c_on} vs {c_off}"
+
+    on.close_ops()
+    print(json.dumps({"ops_smoke": "ok", "requests": len(prompts),
+                      "mid_serve_scrapes": mid_serve["metrics"],
+                      "families": len(fams),
+                      "ttft_count": int(on.tracer.ttft.count),
+                      "host_syncs": c_on["host_syncs"]}))
+    return 0
+
+
 def elastic_smoke():
     """CI smoke for elastic training fault tolerance (ISSUE 7 acceptance):
     a 4-worker CPU run under the elastic agent with TWO injected faults —
@@ -798,6 +899,7 @@ def main():
              run_smoke_lane("serving_resilience_smoke", "--serving-resilience-smoke"),
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
+             run_smoke_lane("ops_smoke", "--ops-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_drift_families_lane(),
@@ -820,6 +922,8 @@ if __name__ == "__main__":
         sys.exit(serving_fastpath_smoke())
     if "--tracing-smoke" in sys.argv:
         sys.exit(tracing_smoke())
+    if "--ops-smoke" in sys.argv:
+        sys.exit(ops_smoke())
     if "--serving-recovery-smoke" in sys.argv:
         sys.exit(serving_recovery_smoke())
     if "--elastic-smoke" in sys.argv:

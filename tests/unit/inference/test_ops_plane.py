@@ -1,0 +1,348 @@
+"""Ops-plane integration suite (ISSUE 11): the v2 serving engine's /metrics,
+/healthz and /statez endpoints, the zero-added-cost guarantee (ServeCounters
+byte-identical server on vs off), the JSON contract on health()/
+state_snapshot(), deterministic gauge timestamps under a FakeClock, the
+per-rank exchange files under the supervisor env, and the supervisor's
+merged fleet endpoint staying monotone across an engine restart."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2, ServingSupervisor
+from deepspeed_tpu.monitor.exposition import parse_exposition, parsed_histogram
+from deepspeed_tpu.monitor.metrics import label_key
+from deepspeed_tpu.monitor.ops_server import read_rank_snapshots, scrape
+from deepspeed_tpu.monitor.telemetry import TelemetryCollector
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.runtime.heartbeat import (OPS_DIR_ENV, SERVING_GENERATION_ENV,
+                                             SERVING_JOURNAL_ENV)
+from tests.unit.fault_injection_serving import FakeClock
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    import numpy as np
+
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, int(n)).tolist()
+               for n in rng.integers(4, 16, 4)]
+    return llama, cfg, params, kw, prompts
+
+
+def _engine(tiny_serving, **over):
+    llama, cfg, params, kw, _ = tiny_serving
+    config = {"dtype": "float32"}
+    config.update(over.pop("config", {}))
+    return InferenceEngineV2(llama, cfg, params, config=config, **kw, **over)
+
+
+def _counter(fams, name):
+    [(_, _, value)] = fams[name]["samples"]
+    return value
+
+
+# ------------------------------------------------------------- live endpoint
+def test_engine_metrics_endpoint_end_to_end(tiny_serving):
+    eng = _engine(tiny_serving, config={
+        "ops_server": {"enabled": True},
+        "serving_tracing": {"enabled": True}})
+    try:
+        assert eng.ops is not None and eng.ops.port > 0
+        prompts = tiny_serving[4]
+        eng.generate(prompts, max_new_tokens=8)
+        body = scrape(eng.ops.url("/metrics"))
+        fams = parse_exposition(body)  # strict-parse clean
+        # the acceptance families: shed/preempt/fastpath counters + the
+        # TTFT/TBT/e2e histograms
+        assert _counter(fams, "dstpu_serving_shed_total") == eng.admission.shed_total
+        assert _counter(fams, "dstpu_serving_preempted_total") == \
+            eng.scheduler.preempted_total
+        assert _counter(fams, "dstpu_serving_completed_total") == len(prompts)
+        assert _counter(fams, "dstpu_fastpath_host_syncs_total") == \
+            eng.counters.host_syncs
+        assert _counter(fams, "dstpu_fastpath_burst_tokens_total") == \
+            eng.counters.burst_tokens
+        for hist_name in ("dstpu_request_ttft_seconds", "dstpu_request_tbt_seconds",
+                          "dstpu_request_e2e_seconds",
+                          "dstpu_request_queue_wait_seconds"):
+            assert fams[hist_name]["type"] == "histogram"
+        # histogram exposition matches the tracer's histogram EXACTLY
+        back = parsed_histogram(
+            fams, "dstpu_request_ttft_seconds",
+            buckets_per_decade=eng.tracer.ttft.buckets_per_decade,
+            min_value=eng.tracer.ttft.min_value)
+        assert back.count == eng.tracer.ttft.count == len(prompts)
+        assert back.percentiles() == eng.tracer.ttft.percentiles()
+    finally:
+        eng.close_ops()
+
+
+def test_healthz_and_statez_mirror_engine_state(tiny_serving):
+    eng = _engine(tiny_serving, config={"ops_server": {"enabled": True}})
+    try:
+        eng.generate(tiny_serving[4], max_new_tokens=8)
+        hz = json.loads(scrape(eng.ops.url("/healthz")))
+        health = eng.health()
+        # the endpoint serves health() verbatim (cached at serve end)
+        assert hz == json.loads(json.dumps(health))
+        assert hz["completed_total"] == len(tiny_serving[4])
+        sz = json.loads(scrape(eng.ops.url("/statez")))
+        assert sz["live_uids"] == [] and sz["queue_depth"] == 0
+        assert sz["flight_recorder"], "statez must carry the recorder tail"
+    finally:
+        eng.close_ops()
+
+
+def test_ops_server_adds_zero_host_link_cost(tiny_serving):
+    """The acceptance guarantee: ServeCounters snapshots byte-identical with
+    the ops server on vs off, and identical tokens — the ops plane reads,
+    it never touches the serve loop's device traffic."""
+    on = _engine(tiny_serving, config={"ops_server": {"enabled": True}})
+    off = _engine(tiny_serving)
+    try:
+        prompts = tiny_serving[4]
+        out_on = on.generate(prompts, max_new_tokens=8)
+        out_off = off.generate(prompts, max_new_tokens=8)
+        assert out_on == out_off, "ops server changed the served tokens"
+        assert on.counters.snapshot() == off.counters.snapshot(), \
+            "ops refresh disturbed the host-link counters"
+        assert on._ops.cache.refreshes > 0
+    finally:
+        on.close_ops()
+
+
+def test_scrape_during_serve_never_syncs(tiny_serving):
+    """A scrape BETWEEN cache refreshes serves the cached strings without
+    executing engine code: the handler thread reads cache attributes only,
+    so the counters cannot move."""
+    eng = _engine(tiny_serving, config={"ops_server": {"enabled": True}})
+    try:
+        eng.generate(tiny_serving[4], max_new_tokens=8)
+        before = eng.counters.snapshot()
+        for _ in range(5):
+            scrape(eng.ops.url("/metrics"))
+            scrape(eng.ops.url("/healthz"))
+        assert eng.counters.snapshot() == before
+    finally:
+        eng.close_ops()
+
+
+# ------------------------------------------------------------- JSON contract
+_JSON_LEAVES = (type(None), bool, int, float, str)
+
+
+def _assert_strict_jsonable(obj, path="$"):
+    """Every leaf must be a PLAIN python scalar (type identity, not
+    isinstance): np.float64 passes json.dumps because it subclasses float,
+    but it still marks a device/numpy value leaking into a payload the ops
+    server serves verbatim — fail it here, in tests, not in a scrape."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            assert type(k) in (str, int), f"{path}: non-plain dict key {k!r}"
+            _assert_strict_jsonable(v, f"{path}.{k}")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _assert_strict_jsonable(v, f"{path}[{i}]")
+    else:
+        assert type(obj) in _JSON_LEAVES, \
+            f"{path}: {type(obj).__name__} ({obj!r}) is not a plain JSON leaf"
+
+
+def test_health_and_snapshot_json_contract(tiny_serving, tmp_path):
+    """ISSUE 11 satellite: the ops server serves health()/state_snapshot()
+    verbatim — a stray ndarray / jax scalar must fail HERE, not in a scrape.
+    The engine is exercised through every state-producing path first
+    (tracing, journaling, shed, live sequences mid-serve)."""
+    eng = _engine(tiny_serving, config={
+        "serving_tracing": {"enabled": True},
+        "serving_resilience": {"max_queue_depth": 3},
+        "serving_fault_tolerance": {"enabled": True,
+                                    "journal_path": str(tmp_path / "j.wal")}})
+    prompts = list(tiny_serving[4]) + [list(range(1, 100))]  # + one shed
+    eng.generate(prompts, max_new_tokens=8, strict=False)
+    # mid-life state too: a live put() sequence with a deadline
+    eng.put([900], [[1, 2, 3]], ttl_s=60.0)
+    eng.step()
+    for payload in (eng.health(), eng.state_snapshot()):
+        json.dumps(payload)            # must not raise
+        _assert_strict_jsonable(payload)  # and no numpy-subclass impostors
+    eng.flush(900)
+
+
+def test_strict_jsonable_catches_numpy_leaves():
+    # the contract-checker itself must catch what json.dumps lets through
+    import numpy as np
+    with pytest.raises(AssertionError, match="float64"):
+        _assert_strict_jsonable({"ok": np.float64(1.0)})
+    with pytest.raises(AssertionError, match="ndarray"):
+        _assert_strict_jsonable({"ok": [np.zeros(2)]})
+
+
+# -------------------------------------------- deterministic gauge timestamps
+def _gauge_timestamps(jsonl_path, prefix):
+    out = []
+    with open(jsonl_path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "gauges" and rec.get("prefix") == prefix:
+                out.append(rec["timestamp"])
+    return out
+
+
+def test_fakeclock_gauge_timestamps_deterministic(tiny_serving, tmp_path):
+    """ISSUE 11 satellite: under an injected clock, record_gauges stamps the
+    engine clock's last read — two identical FakeClock runs produce
+    IDENTICAL timestamp streams, and every stamp lives in the fake domain."""
+    streams = []
+    for run in range(2):
+        jsonl = str(tmp_path / f"t{run}.jsonl")
+        collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+        eng = _engine(tiny_serving, telemetry=collector,
+                      clock=FakeClock(start=1000.0, tick=0.25))
+        eng.generate(tiny_serving[4], max_new_tokens=8)
+        collector.close()
+        stamps = _gauge_timestamps(jsonl, "Inference/Serving")
+        stamps += _gauge_timestamps(jsonl, "Inference/Scheduler")
+        assert stamps, "no gauge records written"
+        assert all(1000.0 <= t < 2000.0 for t in stamps), \
+            "a gauge timestamp came from the wall clock, not the FakeClock"
+        streams.append(stamps)
+    assert streams[0] == streams[1], "FakeClock timestamps are not deterministic"
+
+
+def test_default_clock_gauge_timestamps_stay_wall_clock(tiny_serving, tmp_path):
+    import time
+    jsonl = str(tmp_path / "t.jsonl")
+    collector = TelemetryCollector(config=TelemetryConfig(jsonl_path=jsonl))
+    eng = _engine(tiny_serving, telemetry=collector)  # no injected clock
+    before = time.time()
+    eng.generate(tiny_serving[4], max_new_tokens=8)
+    after = time.time()
+    collector.close()
+    stamps = _gauge_timestamps(jsonl, "Inference/Serving")
+    assert stamps and all(before - 1 <= t <= after + 1 for t in stamps), \
+        "default behavior changed: gauges must stamp wall time"
+
+
+# ------------------------------------------------- per-rank exchange (env)
+def test_engine_publishes_rank_files_under_ops_env(tiny_serving, tmp_path,
+                                                   monkeypatch):
+    ops_dir = str(tmp_path / "ops")
+    # the supervisor exports the ops dir TOGETHER with the journal env; the
+    # engine honors the ops dir only under a serving supervisor (the journal
+    # env marks that), same gate as the heartbeat dir
+    monkeypatch.setenv(OPS_DIR_ENV, ops_dir)
+    monkeypatch.setenv(SERVING_JOURNAL_ENV, str(tmp_path / "j.wal"))
+    monkeypatch.setenv(SERVING_GENERATION_ENV, "2")
+    eng = _engine(tiny_serving)  # env arms publishing without any config
+    eng.generate(tiny_serving[4], max_new_tokens=8)
+    snaps = read_rank_snapshots(ops_dir)
+    assert 0 in snaps and snaps[0]["generation"] == 2
+    fams = snaps[0]["families"]
+    assert fams["dstpu_serving_completed_total"]["samples"][0]["value"] == \
+        len(tiny_serving[4])
+    # the .prom textfile parses too
+    prom = open(os.path.join(ops_dir, "ops.rank0.prom")).read()
+    parse_exposition(prom)
+    assert eng.ops is None, "env-armed publishing must not start a server"
+
+
+def test_engine_ignores_ops_env_outside_serving_supervision(tiny_serving,
+                                                            tmp_path,
+                                                            monkeypatch):
+    """A serving engine inside a supervised TRAINING worker (agent exports
+    DSTPU_OPS_DIR, no serving journal) must not clobber the trainer's ops
+    rank files — the same gate PR 8 applied to the heartbeat dir."""
+    ops_dir = str(tmp_path / "ops")
+    monkeypatch.setenv(OPS_DIR_ENV, ops_dir)
+    eng = _engine(tiny_serving)
+    eng.generate(tiny_serving[4][:2], max_new_tokens=4)
+    assert eng._ops is None
+    assert read_rank_snapshots(ops_dir) == {}
+
+
+# --------------------------------------------- supervisor merged endpoint
+def test_supervisor_merged_endpoint_monotone_across_restart(tiny_serving,
+                                                            tmp_path):
+    """Acceptance: the supervisor endpoint serves merged metrics whose
+    counters are monotone across a worker restart — generation 1 starts from
+    zeroed engine counters, but the fleet counter carries generation 0's."""
+    from deepspeed_tpu.inference.v2 import RequestJournal
+    path = str(tmp_path / "j.wal")
+    prompts = tiny_serving[4]
+    builds = []
+
+    def factory():
+        eng = _engine(tiny_serving)
+        builds.append(eng)
+        if len(builds) == 1:
+            class CrashyJournal(RequestJournal):
+                writes = 0
+
+                def flush(self):
+                    wrote = super().flush()
+                    if wrote:
+                        type(self).writes += 1
+                        if type(self).writes >= 2:
+                            raise RuntimeError("injected crash at wave 2")
+                    return wrote
+
+            eng.journal = CrashyJournal(path, fsync_every=1)
+            eng.journal.open_generation(0)
+        return eng
+
+    sup = ServingSupervisor(factory, journal_path=path,
+                            config={"max_restarts": 2},
+                            ops_server={"enabled": True})
+    try:
+        scraped_totals = []
+
+        real_refresh = sup._refresh_ops
+
+        def spying_refresh(force=False):
+            real_refresh(force=True)
+            body = scrape(sup.ops.url("/metrics"))
+            fams = parse_exposition(body)
+            fam = fams.get("dstpu_scheduler_steps_total")
+            if fam:
+                scraped_totals.append(sum(v for _, _, v in fam["samples"]))
+
+        sup._refresh_ops = spying_refresh
+        results = sup.serve(prompts, max_new_tokens=8)
+        assert sup.restarts_total == 1
+        assert all(r.status == "ok" for r in results)
+        assert len(scraped_totals) >= 2, "expected one scrape per generation"
+        assert scraped_totals == sorted(scraped_totals), \
+            f"merged counter went backwards across the restart: {scraped_totals}"
+        # generation 1 alone ran FEWER steps than the merged total — proof
+        # the carry engaged rather than the restart resetting the fleet view
+        assert scraped_totals[-1] > builds[-1].scheduler.steps
+        body = scrape(sup.ops.url("/metrics"))
+        fams = parse_exposition(body)
+        assert _counter(fams, "dstpu_supervisor_restarts_total") == 1
+        # merged per-rank series carry the rank label
+        sample_labels = [l for _, l, _ in
+                         fams["dstpu_scheduler_steps_total"]["samples"]]
+        assert all(l.get("rank") == "0" for l in sample_labels)
+        hz = json.loads(scrape(sup.ops.url("/healthz")))
+        assert hz["restarts_total"] == 1 and hz["ranks"] == [0]
+        sz = json.loads(scrape(sup.ops.url("/statez")))
+        assert any(e["event"] == "worker_failed" for e in sz["events"])
+    finally:
+        sup.close_ops()
+
+
+def test_supervisor_without_ops_config_stays_dark(tiny_serving, tmp_path):
+    sup = ServingSupervisor(lambda: _engine(tiny_serving),
+                            journal_path=str(tmp_path / "j.wal"))
+    assert sup.ops is None and sup._ops_agg is None
+    sup.serve(tiny_serving[4], max_new_tokens=4)  # no ops plumbing engaged

@@ -149,6 +149,59 @@ class TestHostSyncInHotPath:
             """, self.RULE, filename="deepspeed_tpu/runtime/foo.py")
         assert out == []
 
+    # ---- ops-plane whole-file scan (ISSUE 11): scrape handlers and registry
+    # adapters read host-side cached snapshots only — a device fetch anywhere
+    # in monitor/metrics|exposition|ops_server is a finding, same contract
+    # (and same scan) as runtime/heartbeat.py
+    @pytest.mark.parametrize("fname", ["deepspeed_tpu/monitor/metrics.py",
+                                       "deepspeed_tpu/monitor/exposition.py",
+                                       "deepspeed_tpu/monitor/ops_server.py"])
+    def test_ops_plane_flags_fetch_in_any_function(self, fname):
+        out = run("""
+            import numpy as np
+
+            def populate_from_engine(reg, engine):
+                reg.set_gauge("x", np.asarray(engine.dev_value))
+            """, self.RULE, filename=fname)
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "zero-device-sync" in out[0].message
+
+    def test_ops_plane_flags_item_and_module_level(self):
+        out = run("""
+            import jax
+
+            PROBE = jax.device_get(0)
+
+            def render_family(fam):
+                return fam.value.item()
+            """, self.RULE, filename="deepspeed_tpu/monitor/ops_server.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"] * 2
+
+    def test_ops_plane_allows_host_string_and_float_work(self):
+        # the ops plane is pure host string/arithmetic work: float() parsing,
+        # dict .items() iteration and json dumps must all stay clean
+        out = run("""
+            import json
+
+            def render(reg):
+                out = []
+                for name, fam in reg.families.items():
+                    out.append(f"{name} {float(fam.value)}")
+                return json.dumps(out)
+            """, self.RULE, filename="deepspeed_tpu/monitor/metrics.py")
+        assert out == []
+
+    def test_monitor_files_outside_ops_plane_not_whole_file_scanned(self):
+        # monitor/telemetry.py keeps the default scoping (hot-path names
+        # only) — the whole-file contract covers exactly the ops plane
+        out = run("""
+            import numpy as np
+
+            def collect(dev):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/monitor/telemetry.py")
+        assert out == []
+
     def test_v2_hot_fn_broad_scan_no_duplicate_findings(self):
         out = run("""
             import numpy as np

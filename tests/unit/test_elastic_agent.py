@@ -513,3 +513,70 @@ def test_checkpoint_phase_gets_io_grace_before_indictment(tmp_path):
     (hb_dir / "hb.rank1.json").write_text(json.dumps(
         {"rank": 1, "step": 5, "time": now - 3.0, "collective": None}))
     assert agent._check_liveness(_FakeGroup(2, heartbeat_dir=str(hb_dir))) == [1]
+
+
+# ------------------------------------------------------------ ops endpoint
+def test_agent_serves_merged_fleet_metrics(tmp_path):
+    """ISSUE 11: workers publish per-rank registry snapshots under the
+    agent-exported DSTPU_OPS_DIR; the agent merges them (rank labels, fleet
+    histograms) and serves /metrics + /healthz with liveness gauges."""
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.metrics import label_key
+    from deepspeed_tpu.monitor.ops_server import scrape
+
+    # each worker writes one counter + a heartbeat-style snapshot, then exits
+    script = (
+        "import json, os, time\n"
+        "from deepspeed_tpu.monitor.metrics import MetricsRegistry\n"
+        "from deepspeed_tpu.monitor.ops_server import write_rank_files\n"
+        "rank = int(os.environ['RANK'])\n"
+        "gen = int(os.environ.get('DSTPU_ELASTIC_RESTART', '0'))\n"
+        "reg = MetricsRegistry(generation=gen)\n"
+        "reg.set_counter('dstpu_worker_steps_total', 10 + rank)\n"
+        "write_rank_files(os.environ['DSTPU_OPS_DIR'], rank, reg)\n"
+        "time.sleep(0.5)\n")  # linger so the poll loop sees the files live
+    agent = DSElasticAgent([sys.executable, "-c", script], world_size=2,
+                           poll_interval=0.05, ops_port=0,
+                           ops_dir=str(tmp_path / "ops"))
+    try:
+        assert agent.ops is not None and agent.ops.port > 0
+        assert agent.run() == 0
+        agent._refresh_ops(group=None)  # final sweep after the run
+        body = scrape(agent.ops.url("/metrics"))
+        fams = parse_exposition(body)
+        steps = fams["dstpu_worker_steps_total"]["samples"]
+        by_rank = {labels["rank"]: value for _, labels, value in steps}
+        assert by_rank == {"0": 10.0, "1": 11.0}
+        [(_, _, restarts)] = fams["dstpu_elastic_restarts_total"]["samples"]
+        assert restarts == 0
+        hz = json.loads(scrape(agent.ops.url("/healthz")))
+        assert hz["world_size"] == 2 and hz["ranks_reporting"] == [0, 1]
+        sz = json.loads(scrape(agent.ops.url("/statez")))
+        assert "events" in sz and "restart_count" in sz
+    finally:
+        agent.close_ops()
+
+
+def test_agent_default_ops_tempdir_swept_on_clean_run(tmp_path):
+    # ops_port with no ops_dir derives a tempdir; a clean run must sweep it
+    # (launcher convention) — caller-provided dirs are never touched
+    agent = DSElasticAgent([sys.executable, "-c", "pass"], world_size=1,
+                           poll_interval=0.05, ops_port=0)
+    try:
+        derived = agent._ops_dir
+        assert agent._ops_own_dir and os.path.isdir(derived)
+        assert agent.run() == 0
+        assert not os.path.exists(derived), "tempdir exchange files leaked"
+    finally:
+        agent.close_ops()
+
+
+def test_agent_without_ops_flags_scrubs_inherited_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_OPS_DIR", str(tmp_path / "foreign"))
+    seen = tmp_path / "env.txt"
+    script = (f"import os; open({str(seen)!r}, 'w').write("
+              "os.environ.get('DSTPU_OPS_DIR', '<none>'))")
+    agent = DSElasticAgent([sys.executable, "-c", script], world_size=1,
+                           poll_interval=0.05)
+    assert agent.run() == 0
+    assert seen.read_text() == "<none>"

@@ -1,0 +1,453 @@
+"""Ops-plane unit suite (ISSUE 11): metrics registry, Prometheus exposition
+correctness (HELP/TYPE/label escaping via the in-tree mini parser, histogram
+cumulative-bucket round-trips with exact quantiles), fleet aggregation with
+monotone counters across worker restarts, the HTTP endpoints, and the
+per-rank exchange files.  Pure host-side — no jax, no engine."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.monitor.exposition import (CONTENT_TYPE, ExpositionError,
+                                              bucket_index_of_edge,
+                                              bucket_upper_edge,
+                                              cumulative_buckets,
+                                              parse_exposition,
+                                              parsed_histogram, render)
+from deepspeed_tpu.monitor.metrics import (FleetAggregator, MetricFamily,
+                                           MetricsRegistry, label_key)
+from deepspeed_tpu.monitor.ops_server import (OpsCache, OpsServer,
+                                              read_rank_snapshots, scrape,
+                                              snapshot_path, textfile_path,
+                                              try_start_ops_server,
+                                              write_rank_files)
+from deepspeed_tpu.monitor.tracing import StreamingHistogram
+
+
+def _hist(values, bpd=6, min_value=1e-5):
+    h = StreamingHistogram(bpd, min_value)
+    for v in values:
+        h.add(v)
+    return h
+
+
+# --------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_gauge_histogram_families(self):
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_a_total", 3, help_text="a")
+        reg.set_gauge("dstpu_b", -1.5, labels={"rank": "0"})
+        reg.set_histogram("dstpu_c_seconds", _hist([0.1, 0.2]))
+        assert reg.families["dstpu_a_total"].kind == "counter"
+        assert reg.families["dstpu_b"].samples[label_key({"rank": "0"})] == -1.5
+        assert reg.families["dstpu_c_seconds"].samples[()].count == 2
+
+    def test_counter_monotonicity_enforced_within_generation(self):
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_a_total", 5)
+        reg.set_counter("dstpu_a_total", 7)  # forward is fine
+        with pytest.raises(ValueError, match="went backwards"):
+            reg.set_counter("dstpu_a_total", 2)
+
+    def test_type_conflicts_and_bad_names_rejected(self):
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_a_total", 1)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.set_gauge("dstpu_a_total", 1)
+        with pytest.raises(ValueError, match="invalid metric name"):
+            reg.set_gauge("0bad-name", 1)
+        with pytest.raises(ValueError, match="invalid label name"):
+            reg.set_gauge("dstpu_ok", 1, labels={"bad-label": "x"})
+        with pytest.raises(ValueError, match="reserved"):
+            reg.set_gauge("dstpu_ok", 1, labels={"le": "0.1"})
+
+    def test_histogram_values_are_cloned(self):
+        src = _hist([0.1])
+        reg = MetricsRegistry()
+        reg.set_histogram("dstpu_h_seconds", src)
+        src.add(0.2)  # later source mutation must not skew the registry copy
+        assert reg.families["dstpu_h_seconds"].samples[()].count == 1
+
+    def test_collector_callbacks_run_at_collect(self):
+        reg = MetricsRegistry()
+        state = {"n": 0}
+
+        def fill(r):
+            state["n"] += 1
+            r.set_counter("dstpu_n_total", state["n"])
+
+        reg.register_collector(fill)
+        fams = reg.collect()
+        assert fams["dstpu_n_total"].samples[()] == 1
+        reg.collect()
+        assert reg.families["dstpu_n_total"].samples[()] == 2
+
+    def test_snapshot_round_trip_identical_rendering(self):
+        reg = MetricsRegistry(generation=3)
+        reg.set_counter("dstpu_a_total", 11, labels={"kind": "x"})
+        reg.set_gauge("dstpu_b", 2.25)
+        reg.set_histogram("dstpu_c_seconds", _hist([0.0, 1e-4, 0.5]))
+        snap = reg.snapshot()
+        json.dumps(snap)  # the exchange format must be JSON-clean
+        back = MetricsRegistry.from_snapshot(snap)
+        assert back.generation == 3
+        assert render(back) == render(reg)
+
+
+# -------------------------------------------------------------- exposition
+class TestExposition:
+    def test_help_type_and_sample_lines(self):
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_req_total", 7, help_text="total requests")
+        text = render(reg)
+        assert "# HELP dstpu_req_total total requests\n" in text
+        assert "# TYPE dstpu_req_total counter\n" in text
+        assert "\ndstpu_req_total 7\n" in text
+        fams = parse_exposition(text)
+        assert fams["dstpu_req_total"]["type"] == "counter"
+        assert fams["dstpu_req_total"]["help"] == "total requests"
+        assert fams["dstpu_req_total"]["samples"] == [("dstpu_req_total", {}, 7.0)]
+
+    def test_label_and_help_escaping_round_trip(self):
+        gnarly = 'quote:" backslash:\\ newline:\n end'
+        reg = MetricsRegistry()
+        reg.set_gauge("dstpu_g", 1.0, labels={"path": gnarly},
+                      help_text="help with \\ and\nnewline")
+        text = render(reg)
+        sample_lines = [l for l in text.splitlines() if l.startswith("dstpu_g{")]
+        assert len(sample_lines) == 1  # escaped newline keeps it one line
+        fams = parse_exposition(text)
+        _, labels, value = fams["dstpu_g"]["samples"][0]
+        assert labels["path"] == gnarly  # exact unescape round-trip
+        assert fams["dstpu_g"]["help"] == "help with \\ and\nnewline"
+
+    def test_every_rendered_family_parses(self):
+        # one registry exercising all three kinds + labels must round-trip
+        # through the strict parser without a single tolerance
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_a_total", 2, labels={"rank": "1"})
+        reg.set_counter("dstpu_a_total", 4, labels={"rank": "2"})
+        reg.set_gauge("dstpu_b", 0.125)
+        reg.set_histogram("dstpu_c_seconds", _hist([0.01, 0.2, 0.2, 3.0]),
+                          labels={"rank": "1"})
+        fams = parse_exposition(render(reg))
+        assert set(fams) == {"dstpu_a_total", "dstpu_b", "dstpu_c_seconds"}
+        assert len(fams["dstpu_a_total"]["samples"]) == 2
+
+    def test_parser_rejects_malformed_payloads(self):
+        with pytest.raises(ExpositionError, match="no preceding # TYPE"):
+            parse_exposition("dstpu_x 1\n")
+        with pytest.raises(ExpositionError, match="bad TYPE"):
+            parse_exposition("# TYPE dstpu_x flavor\ndstpu_x 1\n")
+        with pytest.raises(ExpositionError, match="bad label syntax"):
+            parse_exposition('# TYPE dstpu_x gauge\ndstpu_x{bad} 1\n')
+        with pytest.raises(ExpositionError, match="bad value"):
+            parse_exposition("# TYPE dstpu_x gauge\ndstpu_x pancake\n")
+        with pytest.raises(ExpositionError, match="without le"):
+            parse_exposition("# TYPE dstpu_x histogram\ndstpu_x_bucket 1\n")
+        with pytest.raises(ExpositionError, match="missing \\+Inf"):
+            parse_exposition('# TYPE dstpu_x histogram\n'
+                             'dstpu_x_bucket{le="0.1"} 1\n')
+        with pytest.raises(ExpositionError, match="decrease"):
+            parse_exposition('# TYPE dstpu_x histogram\n'
+                             'dstpu_x_bucket{le="0.1"} 3\n'
+                             'dstpu_x_bucket{le="0.5"} 2\n'
+                             'dstpu_x_bucket{le="+Inf"} 3\n')
+        with pytest.raises(ExpositionError, match="!= _count"):
+            parse_exposition('# TYPE dstpu_x histogram\n'
+                             'dstpu_x_bucket{le="+Inf"} 3\n'
+                             'dstpu_x_count 5\n')
+
+    def test_content_type_is_004(self):
+        assert "version=0.0.4" in CONTENT_TYPE
+
+
+# --------------------------------------------------- histogram round-trips
+class TestHistogramExposition:
+    def test_cumulative_buckets_exact_sum_count(self):
+        h = _hist([0.0, 2e-6, 1e-4, 0.02, 0.02, 0.5, 7.0])
+        buckets = cumulative_buckets(h)
+        assert buckets[-1][1] == h.count  # last cumulative == total count
+        # cumulative counts are non-decreasing and edges ascend
+        edges = [le for le, _ in buckets]
+        cums = [c for _, c in buckets]
+        assert edges == sorted(edges) and cums == sorted(cums)
+        # underflow values (0.0, 2e-6) land under the min_value edge
+        assert edges[0] == h.min_value and cums[0] == 2
+
+    def test_edge_index_inverse(self):
+        h = StreamingHistogram(6, 1e-5)
+        for idx in (-1, 0, 1, 5, 17, 42):
+            le = bucket_upper_edge(h, idx)
+            assert bucket_index_of_edge(le, 6, 1e-5) == idx
+
+    @pytest.mark.parametrize("values", [
+        [0.001],
+        [0.0, 0.0, 0.0],                      # all underflow
+        [1e-4, 2e-3, 2e-3, 0.5, 0.5, 0.5, 9.0],
+        [0.0, 2e-6, 1e-4, 0.02, 0.02, 0.5, 7.0, 7.0, 120.0],
+    ])
+    def test_round_trip_quantiles_exact(self, values):
+        h = _hist(values)
+        reg = MetricsRegistry()
+        reg.set_histogram("dstpu_lat_seconds", h)
+        text = render(reg)
+        fams = parse_exposition(text)
+        back = parsed_histogram(fams, "dstpu_lat_seconds",
+                                buckets_per_decade=6, min_value=1e-5)
+        # the exposition carries EXACT buckets: every quantile, the count and
+        # the sum of the reconstructed histogram match the source identically
+        assert back.counts == h.counts
+        assert back.count == h.count
+        assert back.total == pytest.approx(h.total, abs=0.0)
+        for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+            assert back.quantile(q) == h.quantile(q), q
+
+    def test_round_trip_with_labels(self):
+        reg = MetricsRegistry()
+        reg.set_histogram("dstpu_lat_seconds", _hist([0.1, 0.2]),
+                          labels={"rank": "3"})
+        fams = parse_exposition(render(reg))
+        back = parsed_histogram(fams, "dstpu_lat_seconds",
+                                buckets_per_decade=6, min_value=1e-5,
+                                labels={"rank": "3"})
+        assert back.count == 2
+
+
+# --------------------------------------------------------- fleet aggregation
+class TestFleetAggregator:
+    def test_merge_labels_counters_by_rank(self):
+        agg = FleetAggregator()
+        for rank, n in ((0, 5), (1, 8)):
+            reg = MetricsRegistry()
+            reg.set_counter("dstpu_req_total", n, help_text="reqs")
+            agg.absorb(rank, reg.snapshot())
+        merged = agg.registry()
+        fam = merged.families["dstpu_req_total"]
+        assert fam.samples[label_key({"rank": "0"})] == 5
+        assert fam.samples[label_key({"rank": "1"})] == 8
+        assert fam.help == "reqs"
+
+    def test_counters_monotone_across_generation_bump(self):
+        """The restart contract: a worker that crashes at counter=7 and
+        restarts (generation bump, counters reset to 0) must NEVER make the
+        merged counter go backwards — the dead generation's total carries."""
+        agg = FleetAggregator()
+        gen0 = MetricsRegistry(generation=0)
+        gen0.set_counter("dstpu_req_total", 7)
+        agg.absorb(0, gen0.snapshot())
+        seen = [agg.registry().families["dstpu_req_total"].samples[
+            label_key({"rank": "0"})]]
+        for value in (0, 2, 5):  # the restarted generation counts back up
+            gen1 = MetricsRegistry(generation=1)
+            gen1.set_counter("dstpu_req_total", value)
+            agg.absorb(0, gen1.snapshot())
+            seen.append(agg.registry().families["dstpu_req_total"].samples[
+                label_key({"rank": "0"})])
+        assert seen == [7, 7, 9, 12]          # monotone, carry + current
+        # a second restart compounds the carry
+        gen2 = MetricsRegistry(generation=2)
+        gen2.set_counter("dstpu_req_total", 1)
+        agg.absorb(0, gen2.snapshot())
+        assert agg.registry().families["dstpu_req_total"].samples[
+            label_key({"rank": "0"})] == 13
+
+    def test_stale_generation_snapshot_ignored(self):
+        agg = FleetAggregator()
+        gen1 = MetricsRegistry(generation=1)
+        gen1.set_counter("dstpu_req_total", 4)
+        agg.absorb(0, gen1.snapshot())
+        stale = MetricsRegistry(generation=0)
+        stale.set_counter("dstpu_req_total", 99)
+        agg.absorb(0, stale.snapshot())  # a straggler file must not roll back
+        assert agg.registry().families["dstpu_req_total"].samples[
+            label_key({"rank": "0"})] == 4
+
+    def test_histograms_merge_rank_blind_and_across_restart(self):
+        agg = FleetAggregator()
+        a = _hist([0.001, 0.01])
+        b = _hist([0.1, 1.0])
+        union = _hist([0.001, 0.01, 0.1, 1.0])
+        for rank, h in ((0, a), (1, b)):
+            reg = MetricsRegistry()
+            reg.set_histogram("dstpu_lat_seconds", h)
+            agg.absorb(rank, reg.snapshot())
+        merged = agg.registry().families["dstpu_lat_seconds"].samples[()]
+        assert merged.counts == union.counts
+        assert merged.percentiles() == union.percentiles()
+        # rank 0 restarts with fresh samples: old ones carry, not vanish
+        reg = MetricsRegistry(generation=1)
+        reg.set_histogram("dstpu_lat_seconds", _hist([5.0]))
+        agg.absorb(0, reg.snapshot())
+        merged = agg.registry().families["dstpu_lat_seconds"].samples[()]
+        assert merged.count == 5
+
+    def test_gauges_take_newest_per_rank(self):
+        agg = FleetAggregator()
+        for value in (3.0, 1.0):
+            reg = MetricsRegistry()
+            reg.set_gauge("dstpu_depth", value)
+            agg.absorb(0, reg.snapshot())
+        assert agg.registry().families["dstpu_depth"].samples[
+            label_key({"rank": "0"})] == 1.0  # gauges may go down
+
+    def test_merged_registry_renders_and_parses(self):
+        agg = FleetAggregator()
+        for rank in (0, 1):
+            reg = MetricsRegistry()
+            reg.set_counter("dstpu_req_total", rank + 1)
+            reg.set_histogram("dstpu_lat_seconds", _hist([0.1 * (rank + 1)]))
+            agg.absorb(rank, reg.snapshot())
+        parse_exposition(render(agg.registry()))  # strict-parse clean
+
+
+# ----------------------------------------------------------- HTTP endpoints
+class TestOpsServer:
+    def test_endpoints_serve_cached_payloads(self):
+        cache = OpsCache()
+        cache.update(metrics_text="# TYPE dstpu_x gauge\ndstpu_x 1\n",
+                     healthz='{"ok": true}', statez='{"state": []}')
+        server = OpsServer(cache)
+        try:
+            assert server.port > 0  # ephemeral bind
+            body = scrape(server.url("/metrics"))
+            assert parse_exposition(body)["dstpu_x"]["samples"][0][2] == 1.0
+            assert json.loads(scrape(server.url("/healthz"))) == {"ok": True}
+            assert json.loads(scrape(server.url("/statez"))) == {"state": []}
+            index = json.loads(scrape(server.url("/")))
+            assert "/metrics" in index["endpoints"]
+            with pytest.raises(RuntimeError, match="404"):
+                scrape(server.url("/nope"))
+        finally:
+            server.close()
+
+    def test_cache_update_is_visible_to_next_scrape(self):
+        cache = OpsCache()
+        server = OpsServer(cache)
+        try:
+            assert scrape(server.url("/metrics")) == ""
+            cache.update(metrics_text="# TYPE dstpu_y counter\ndstpu_y 2\n")
+            assert "dstpu_y 2" in scrape(server.url("/metrics"))
+            assert cache.refreshes == 1
+        finally:
+            server.close()
+
+    def test_interpreter_exit_with_live_listener_does_not_hang(self):
+        """A process that exits WITHOUT close() must terminate: __del__ runs
+        during interpreter finalization, where daemon threads are already
+        frozen and a blocking ``httpd.shutdown()`` would wait forever on an
+        acknowledgement that can never come."""
+        import subprocess
+        import sys as _sys
+        proc = subprocess.run(
+            [_sys.executable, "-c",
+             "from deepspeed_tpu.monitor.ops_server import OpsCache, OpsServer\n"
+             "server = OpsServer(OpsCache())\n"
+             "print(server.port)\n"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert int(proc.stdout.strip()) > 0
+
+    def test_try_start_degrades_on_busy_port(self):
+        cache = OpsCache()
+        first = try_start_ops_server(cache, port=0, owner="test")
+        assert first is not None
+        try:
+            second = try_start_ops_server(OpsCache(), port=first.port,
+                                          owner="test")
+            assert second is None  # degrade, never raise
+        finally:
+            first.close()
+
+
+# ----------------------------------------------------------- ops publisher
+class TestOpsPublisher:
+    def _cfg(self, **over):
+        from deepspeed_tpu.runtime.config import OpsServerConfig
+        return OpsServerConfig(**over)
+
+    def test_throttle_and_force(self, tmp_path):
+        from deepspeed_tpu.monitor.ops_server import OpsPublisher
+        pub = OpsPublisher(self._cfg(refresh_interval_s=10.0),
+                           ops_dir=str(tmp_path))
+        n = {"calls": 0}
+
+        def populate(reg):
+            n["calls"] += 1
+            reg.set_counter("dstpu_n_total", n["calls"])
+
+        assert pub.refresh(populate, now=100.0) is True
+        assert pub.refresh(populate, now=105.0) is False   # inside interval
+        assert pub.refresh(populate, now=105.0, force=True) is True
+        assert pub.refresh(populate, now=111.0) is False   # force restarted it
+        assert pub.refresh(populate, now=115.5) is True    # interval elapsed
+        assert n["calls"] == 3
+        assert 0 in read_rank_snapshots(str(tmp_path))
+
+    def test_counter_rewind_exposed_as_reset_same_generation(self):
+        """A source counter that legally rewinds (checkpoint rollback) must
+        surface as a standard Prometheus counter reset — fresh counts, SAME
+        generation (a bump would double-count non-rewound counters through
+        the fleet carry) — and never raise into the owning loop."""
+        from deepspeed_tpu.monitor.ops_server import OpsPublisher
+        pub = OpsPublisher(self._cfg(), generation=4)
+        state = {"steps": 1000}
+        populate = lambda reg: reg.set_counter("dstpu_steps_total",
+                                               state["steps"])
+        pub.refresh(populate, now=0.0, force=True)
+        state["steps"] = 900  # rollback
+        pub.refresh(populate, now=1.0, force=True)
+        assert pub.registry.generation == 4
+        assert pub.registry.families["dstpu_steps_total"].samples[()] == 900
+        assert "dstpu_steps_total 900" in pub.cache.metrics_text
+
+    def test_payload_callables_skipped_when_throttled(self):
+        from deepspeed_tpu.monitor.ops_server import OpsPublisher
+        pub = OpsPublisher(self._cfg(refresh_interval_s=10.0))
+        built = {"healthz": 0}
+
+        def healthz():
+            built["healthz"] += 1
+            return "{}"
+
+        pub.refresh(lambda reg: None, now=0.0, force=True, healthz=healthz)
+        pub.refresh(lambda reg: None, now=1.0, healthz=healthz)  # throttled
+        assert built["healthz"] == 1  # a throttled call renders nothing
+
+
+# --------------------------------------------------------- rank file exchange
+class TestRankFiles:
+    def test_write_and_read_round_trip(self, tmp_path):
+        reg = MetricsRegistry(generation=2)
+        reg.set_counter("dstpu_req_total", 9)
+        d = str(tmp_path / "ops")
+        assert write_rank_files(d, 3, reg)
+        assert os.path.exists(snapshot_path(d, 3))
+        prom = open(textfile_path(d, 3)).read()
+        parse_exposition(prom)
+        snaps = read_rank_snapshots(d)
+        assert set(snaps) == {3} and snaps[3]["generation"] == 2
+        assert render(MetricsRegistry.from_snapshot(snaps[3])) == render(reg)
+
+    def test_torn_and_foreign_files_read_as_absent(self, tmp_path):
+        d = str(tmp_path)
+        with open(os.path.join(d, "ops.rank0.json"), "w") as fh:
+            fh.write('{"namespace": "dstpu", "fam')  # torn write
+        with open(os.path.join(d, "unrelated.json"), "w") as fh:
+            fh.write("{}")
+        # valid JSON, wrong shape: a foreign/version-skewed writer must read
+        # as absent, never crash the supervisor/agent poll loop downstream
+        with open(os.path.join(d, "ops.rank1.json"), "w") as fh:
+            fh.write('[1, 2, 3]')
+        with open(os.path.join(d, "ops.rank2.json"), "w") as fh:
+            fh.write('{"generation": 0, "families": "not-a-dict"}')
+        assert read_rank_snapshots(d) == {}
+        assert read_rank_snapshots(os.path.join(d, "missing")) == {}
+
+    def test_broken_dir_degrades_to_false(self, tmp_path):
+        target = tmp_path / "blocked"
+        target.write_text("a file where the dir should be")
+        reg = MetricsRegistry()
+        reg.set_counter("dstpu_a_total", 1)
+        assert write_rank_files(str(target), 0, reg) is False

@@ -124,6 +124,52 @@ def test_rate_counter(tmp_path):
     assert r is not None and r > 0
 
 
+def test_record_gauges_timestamp_override(tmp_path):
+    """ISSUE 11 satellite: an explicit timestamp (the serving engine's
+    injected-clock read) stamps the record deterministically; the default
+    stays wall clock."""
+    import time
+    tel = make_collector(tmp_path)
+    rec = tel.record_gauges({"depth": 1.0}, step=1, timestamp=1234.5)
+    assert rec["timestamp"] == 1234.5
+    before = time.time()
+    rec = tel.record_gauges({"depth": 2.0}, step=2)  # default: wall clock
+    assert before - 1 <= rec["timestamp"] <= time.time() + 1
+    on_disk = read_jsonl(tmp_path / "telemetry.jsonl")
+    assert on_disk[0]["timestamp"] == 1234.5
+
+
+def test_ops_caches_track_records(tmp_path):
+    """The ops plane reads the collector's cached last record / last gauges /
+    resilience counts (monitor/metrics.populate_from_telemetry) — they must
+    track every record family."""
+    tel = make_collector(tmp_path, peak_flops_per_chip=1e12)
+    assert tel.last_train_record is None and tel.last_gauges == {}
+    rec = tel.record_train_step(step=1, samples=4, loss=2.0, step_time_s=0.5)
+    assert tel.last_train_record is rec
+    tel.record_gauges({"queue_depth": 3.0}, step=2, prefix="Inference/Scheduler")
+    assert tel.last_gauges["Inference/Scheduler"]["queue_depth"] == 3.0
+    tel.record_resilience("save_retry", step=3)
+    tel.record_resilience("save_retry", step=4)
+    assert tel.resilience_counts == {"save_retry": 2}
+
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry, label_key
+    from deepspeed_tpu.monitor.metrics import populate_from_telemetry
+    reg = MetricsRegistry()
+    populate_from_telemetry(reg, tel)
+    # absolute position is a GAUGE (it survives checkpoint resumes; counter
+    # semantics belong to per-process work, which only the engine knows)
+    assert reg.families["dstpu_train_global_step"].samples[()] == 1
+    assert reg.families["dstpu_train_global_step"].kind == "gauge"
+    assert reg.families["dstpu_train_loss"].samples[()] == 2.0
+    assert reg.families["dstpu_inference_scheduler_queue_depth"].samples[()] == 3.0
+    # the record's bookkeeping keys must NOT leak into the metric surface
+    assert "dstpu_inference_scheduler_step" not in reg.families
+    assert "dstpu_inference_scheduler_timestamp" not in reg.families
+    assert reg.families["dstpu_resilience_events_total"].samples[
+        label_key({"event": "save_retry"})] == 2
+
+
 # ------------------------------------------------------- profiler windows
 def test_profile_window_bookkeeping(tmp_path, monkeypatch):
     calls = []
@@ -362,6 +408,72 @@ def test_engine_three_step_run_writes_records_and_traces(tmp_path):
     # trace files landed under the configured dir (TB plugin layout)
     trace_files = [os.path.join(root, f) for root, _, files in os.walk(tracedir) for f in files]
     assert trace_files, "no jax.profiler trace output"
+
+
+def test_engine_ops_endpoint_serves_training_metrics(tmp_path, monkeypatch):
+    """ISSUE 11: a training engine with ops_server.enabled serves /metrics
+    (parsed by the in-tree strict parser) and /healthz over the telemetry
+    caches, and publishes per-rank files under the agent-exported ops dir."""
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.ops_server import read_rank_snapshots, scrape
+    ops_dir = str(tmp_path / "ops")
+    monkeypatch.setenv("DSTPU_OPS_DIR", ops_dir)
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=mlp_loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+            "telemetry": {"jsonl_path": str(tmp_path / "t.jsonl"),
+                          "peak_flops_per_chip": 1e12},
+            "ops_server": {"enabled": True, "refresh_interval_s": 0.0},
+        })
+    try:
+        assert engine.ops is not None and engine.ops.port > 0
+        for s in range(3):
+            engine.train_batch(random_batch(engine.train_batch_size,
+                                            hidden=16, seed=s))
+        body = scrape(engine.ops.url("/metrics"))
+        fams = parse_exposition(body)
+        [(_, _, steps_total)] = fams["dstpu_train_steps_total"]["samples"]
+        assert steps_total == 3
+        [(_, _, global_step)] = fams["dstpu_train_global_step"]["samples"]
+        assert global_step == 3
+        [(_, _, loss)] = fams["dstpu_train_loss"]["samples"]
+        assert np.isfinite(loss)
+        assert "dstpu_train_samples_per_sec" in fams
+        hz = json.loads(scrape(engine.ops.url("/healthz")))
+        assert hz["global_steps"] == 3 and hz["loss"] is not None
+        json.dumps(engine.ops_health())  # JSON contract holds here too
+        snaps = read_rank_snapshots(ops_dir)
+        assert 0 in snaps, "rank 0 must publish exchange files too"
+        # a checkpoint rollback rewinds global_steps: the refresh must expose
+        # a standard Prometheus COUNTER RESET (fresh counts, SAME generation
+        # — a generation bump would double-count every counter that did NOT
+        # rewind via the fleet carry) instead of raising into train_batch
+        generation = engine._ops.registry.generation
+        engine.global_steps = 1
+        engine._refresh_ops(force=True)
+        assert engine._ops.registry.generation == generation
+        fams = parse_exposition(scrape(engine.ops.url("/metrics")))
+        [(_, _, steps_total)] = fams["dstpu_train_steps_total"]["samples"]
+        assert steps_total == 1
+        # a checkpoint RESUME moves the counter base: exported counters are
+        # this-process work (so the fleet carry never double-counts the
+        # resumed prefix), while the absolute position stays a gauge
+        ckpt = str(tmp_path / "ckpt")
+        engine.save_checkpoint(ckpt, tag="t1")
+        engine.load_checkpoint(ckpt, tag="t1")
+        assert engine._ops_steps_base == engine.global_steps == 1
+        engine._refresh_ops(force=True)
+        fams = parse_exposition(scrape(engine.ops.url("/metrics")))
+        [(_, _, steps_total)] = fams["dstpu_train_steps_total"]["samples"]
+        assert steps_total == 0  # no process work since the resume
+        [(_, _, global_step)] = fams["dstpu_train_global_step"]["samples"]
+        assert global_step == 1  # the absolute position survives as a gauge
+    finally:
+        engine.close_ops()
+        engine.telemetry.close()
 
 
 def test_engine_mfu_resolves_when_gas_equals_train_batch(tmp_path):
