@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -80,6 +80,15 @@ tracing-smoke:
 # off (scrapes read host-side cached snapshots; zero added device syncs)
 ops-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --ops-smoke
+
+# KV-pool observability (ISSUE 12): a shared-prefix serve must report a
+# non-zero counterfactual prefix-cache win (duplicate blocks + hit-rate +
+# prefill tokens saved) with the serving_kv_* families strict-parsing off
+# /metrics, the census-vs-allocator partition invariant must hold through a
+# 25%-fault-injected serve, and the fastpath ServeCounters must be
+# byte-identical with kv observability on vs off (zero added device syncs)
+kv-obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --kv-obs-smoke
 
 # serving fault tolerance (ISSUE 8): kill a real serving worker mid-decode;
 # supervised restart + journal replay must bring every request to a terminal

@@ -802,6 +802,9 @@ def measure_serving_mixed(on_tpu: bool):
     # snapshot the SLO percentiles NOW: they must describe exactly the one
     # timed pass above, not the extra A/B passes the journal block runs
     pct = eng.tracer.percentiles()
+    # same discipline for the KV-pool report: capture it before the journal
+    # A/B re-runs the scenario on this engine three more times
+    kv_report = _kv_report("serving_mixed", eng)
 
     # journaling durability tax (ISSUE 8): the identical scenario on a
     # journal-armed engine (fsync_every=0, the throughput deploy setting —
@@ -873,12 +876,101 @@ def measure_serving_mixed(on_tpu: bool):
             # durability tax (ISSUE 8): tok/s with the request journal armed
             # vs off, same scenario (fsync_every=0; see comment above)
             "serving_mixed_journal_overhead_pct": journal_overhead_pct,
+            # KV-pool observability (ISSUE 12): fragmentation at end of the
+            # timed pass, the counterfactual prefix-cache opportunity this
+            # (random-prompt) workload offers, and the forecaster's lifetime
+            # pressure events — random prompts should report ~zero sharing;
+            # the shared-prefix scenario below is where the hit-rate is real
+            **kv_report,
             # ops-plane refresh cost (ISSUE 11): one full cache rebuild —
             # registry populate from engine host state + Prometheus render +
             # health()/state_snapshot() JSON — i.e. what a serve-loop refresh
             # tick costs the host (scrapes themselves read the cached strings
             # and cost the serve loop nothing)
             **_ops_refresh_cost(eng)}
+
+
+def _kv_report(prefix: str, eng):
+    """Fold the engine's KV-pool observability snapshot (ISSUE 12) into a
+    bench leg's keys: fragmentation, counterfactual prefix-cache opportunity,
+    capacity-forecast pressure.  Prefix values are LAST-PASS (per-observation)
+    numbers, not lifetime totals — the engine's warm pass must not inflate the
+    reported opportunity; call this right after the timed pass."""
+    kv = eng.health().get("kv") or {}
+    if not kv.get("enabled"):
+        return {f"{prefix}_kv": "disabled"}
+    census, pfx = kv["census"], kv["prefix"]
+    return {
+        # PEAK, not point-in-time: a completed scenario always ends with an
+        # empty pool, so end-of-pass fragmentation would be a constant zero
+        f"{prefix}_kv_peak_fragmentation_tokens":
+            census["peak_fragmentation_tokens"],
+        f"{prefix}_kv_peak_allocated_blocks": census["peak_allocated_blocks"],
+        f"{prefix}_kv_blocks_per_request_p50": census["blocks_per_request"]["p50"],
+        f"{prefix}_kv_prefix_hit_rate": round(pfx["last_pass"]["hit_rate"], 4),
+        f"{prefix}_kv_prefix_tokens_saved": pfx["last_pass"]["prefill_tokens_saved"],
+        f"{prefix}_kv_pressure_events_total": kv["pressure_events_total"],
+    }
+
+
+def measure_serving_shared_prefix(on_tpu: bool):
+    """Shared-prefix arrival scenario (ISSUE 12; the ROADMAP prefix-cache
+    benchmark): every request carries the same system-prompt/few-shot header
+    plus a short unique tail — the dominant real-traffic shape prefix caching
+    exists for.  Reports the COUNTERFACTUAL win the PrefixObservatory
+    measures (duplicate blocks, prefill tokens a block-granular prefix cache
+    would have saved, would-be hit-rate) alongside throughput, so when
+    copy-on-write sharing lands, this same scenario becomes its A/B gate."""
+    import jax
+
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_req, header_len, tail_len, max_new = 16, 192, 16, 24
+        num_blocks, block_size, maxb, budget, max_seqs = 2048, 32, 64, 512, 16
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+        n_req, header_len, tail_len, max_new = 6, 24, 4, 4
+        num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 16, 64, 8
+
+    eng = InferenceEngineV2(llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
+                            config={"dtype": "bfloat16" if on_tpu else "float32"},
+                            num_blocks=num_blocks, block_size=block_size,
+                            max_blocks_per_seq=maxb, token_budget=budget,
+                            max_seqs_per_step=max_seqs)
+    rng = np.random.default_rng(0)
+    header = rng.integers(1, cfg.vocab_size, header_len).tolist()
+    prompts = [header + rng.integers(1, cfg.vocab_size, tail_len).tolist()
+               for _ in range(n_req)]
+    # same three-wave arrival shape as serving_mixed: later waves land while
+    # earlier ones decode, so the observatory sees live+admitted overlap
+    arrivals = {0: list(range(n_req // 2)),
+                n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
+                n_req // 4 + 8: list(range(3 * n_req // 4, n_req))}
+    _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
+    # scenario-delta accounting: the observatory's totals are lifetime
+    # counters, so the warm run's passes must be subtracted out — the
+    # reported win is exactly the MEASURED scenario's, the number the
+    # ROADMAP copy-on-write item must realize (and will be A/B'd against)
+    warm = eng.health()["kv"]["prefix"]
+    tokens, dt, lats, hit_stall, _ = _run_serving_scenario(eng, prompts, arrivals, max_new)
+    kv = eng.health()["kv"]
+    d_dup = kv["prefix"]["duplicate_blocks_total"] - warm["duplicate_blocks_total"]
+    d_blocks = kv["prefix"]["prompt_blocks_total"] - warm["prompt_blocks_total"]
+    d_saved = (kv["prefix"]["prefill_tokens_saved_total"]
+               - warm["prefill_tokens_saved_total"])
+    return {"shared_prefix_tok_s": round(tokens / max(dt, 1e-9), 1),
+            "shared_prefix_requests": n_req,
+            "shared_prefix_header_tokens": header_len,
+            "shared_prefix_duplicate_blocks": d_dup,
+            "shared_prefix_hit_rate": round(d_dup / max(d_blocks, 1), 4),
+            "shared_prefix_prefill_tokens_saved": d_saved,
+            "shared_prefix_peak_fragmentation_tokens":
+                kv["census"]["peak_fragmentation_tokens"],
+            "shared_prefix_stalled": bool(hit_stall)}
 
 
 def _ops_refresh_cost(eng, rounds: int = 20):
@@ -1014,6 +1106,7 @@ def main():
         ("bw",      40,  lambda: measure_collective_bw(1 << 30 if on_tpu else 1 << 22,
                                                        50 if on_tpu else 5)),
         ("serving_mixed", 70, lambda: measure_serving_mixed(on_tpu)),
+        ("shared_prefix", 45, lambda: measure_serving_shared_prefix(on_tpu)),
         ("ring",    90,  lambda: measure_ring(on_tpu)),
         ("big",     55,  lambda: measure_training_big(on_tpu)),
         ("infinity", 0,  None),  # placeholder — budget set from remaining budget;

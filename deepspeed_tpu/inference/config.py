@@ -6,7 +6,8 @@ from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
-from ..runtime.config import (OpsServerConfig, ServingFastpathConfig,
+from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
+                              ServingFastpathConfig,
                               ServingFaultToleranceConfig,
                               ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
@@ -58,6 +59,10 @@ class InferenceConfig(ConfigModel):
     # pull-based ops endpoints (/metrics + /healthz + /statez) and per-rank
     # metrics textfiles — monitor/ops_server.py (same dual-spelling contract)
     ops_server: OpsServerConfig = Field(OpsServerConfig)
+    # block-level KV-pool observability: census + prefix-sharing opportunity
+    # + capacity forecast — inference/v2/kv_metrics.py (section defined in
+    # runtime/config.py so train+serve configs share one spelling)
+    serving_kv_observability: KVObservabilityConfig = Field(KVObservabilityConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

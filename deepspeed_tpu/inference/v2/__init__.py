@@ -6,6 +6,8 @@ from .engine_factory import build_engine, build_hf_engine
 from .engine_v2 import InferenceEngineV2
 from .fastpath import PENDING_TOKEN, DeferredTokens, DeviceBatchState, ServeCounters
 from .journal import JournalEntry, JournalState, RequestJournal, replay_journal
+from .kv_metrics import (BlockCensus, CapacityForecaster, CensusInvariantError,
+                         KVObservability, PrefixObservatory, block_hashes)
 from .ragged_manager import (EmptyPromptError, RaggedStateManager, SequenceDescriptor,
                              UnknownSequenceError)
 from .scheduler import ScheduledChunk, SplitFuseScheduler

@@ -35,6 +35,12 @@ class BlockedAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def free_block_set(self) -> frozenset:
+        """The free list as a set — the block census checks its owned set
+        partitions exactly against this (kv_metrics.BlockCensus.check_against,
+        the PR-4 double-free guard as a continuously-checked pool invariant)."""
+        return frozenset(self._free)
+
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise KVAllocationError(f"KV pool exhausted: requested {n}, free {len(self._free)}")

@@ -38,6 +38,7 @@ from .blocked_allocator import KVAllocationError
 from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchState,
                        ServeCounters, materialize, round_up_pow2)
 from .journal import RequestJournal, journal_bytes
+from .kv_metrics import KVObservability
 from .ragged_manager import RaggedStateManager
 from .scheduler import SplitFuseScheduler
 
@@ -94,6 +95,24 @@ class InferenceEngineV2:
         self.dtype = _DTYPES[self.config.dtype]
         self.block_size = block_size
         self.manager = RaggedStateManager(num_blocks, block_size, max_blocks_per_seq)
+        # block-level KV-pool observability (ISSUE 12): census + prefix-
+        # sharing opportunity + capacity forecast, all from host state the
+        # manager/allocator already own — zero device syncs (the kv-obs smoke
+        # proves ServeCounters byte-identical on vs off)
+        self.kv_cfg = self.config.serving_kv_observability
+        self.kv_obs: Optional[KVObservability] = None
+        if self.kv_cfg.enabled:
+            self.kv_obs = KVObservability(
+                block_size, num_blocks, self.manager.trash_block,
+                ewma_alpha=self.kv_cfg.ewma_alpha,
+                pressure_steps=self.kv_cfg.pressure_steps,
+                age_buckets_per_decade=self.kv_cfg.age_buckets_per_decade)
+            self.manager.census = self.kv_obs.census
+        # serve-step clock for kv observability: stepwise dispatches count 1,
+        # a fused decode burst of k counts k — so block ages and the
+        # forecaster's per-step rates mean the same thing on every decode
+        # path (the scheduler's step counter never advances inside a burst)
+        self._kv_steps = 0
         # telemetry: a monitor.TelemetryCollector; the scheduler emits its
         # gauges through it and step() adds serving rates (ISSUE 1 tentpole)
         self.telemetry = telemetry
@@ -281,6 +300,10 @@ class InferenceEngineV2:
                                           ttl_s=ttl, max_new_tokens=0)
             self.tracer.event("admit", uid=int(uid), direct=True)
             self.tracer.on_admit(int(uid), now, prompt_len=len(prompt))
+        # prefix-sharing opportunity over the post-intake live set (the put()
+        # analog of _serve's per-pass observation; the new sequences are
+        # already live, so no extras needed)
+        self._observe_prefix({})
 
     def flush(self, uid: int) -> None:
         seq = self.manager.seqs.get(uid)
@@ -481,6 +504,8 @@ class InferenceEngineV2:
                 emits.append((c.uid, len(seq.tokens) - 1, i))
                 row_of[c.uid] = i
         self.counters.step_tokens += len(emits)
+        self._kv_steps += 1
+        self._refresh_kv()
         self._emit_serving_gauges(tokens_run=tokens_run)
         return DeferredTokens(toks_dev=toks_dev, emits=emits, row_of=row_of,
                               counters=self.counters, tracer=self.tracer,
@@ -541,6 +566,8 @@ class InferenceEngineV2:
         self.tracer.on_tokens_map(out)
         if self.journal is not None:
             self.journal.note_token_map(out)
+        self._kv_steps += 1
+        self._refresh_kv()
         self._emit_serving_gauges(tokens_run=int(n_tokens.sum()))
         return out
 
@@ -549,6 +576,75 @@ class InferenceEngineV2:
         clock (FakeClock tests): the clock's last donated read.  None keeps
         record_gauges' wall-clock default — unchanged production behavior."""
         return self.tracer.last_now if self._clock_injected else None
+
+    # ------------------------------------------------------ kv observability
+    def _refresh_kv(self) -> None:
+        """Wave-boundary census/forecast refresh (ISSUE 12): update per-block
+        residency + last-touched stamps from ``seen_tokens``, sample the
+        alloc/free rates into the capacity forecaster, land pressure-edge
+        events in the flight recorder, and append a Chrome-trace counter-track
+        sample when a trace export is configured.  Pure host arithmetic over
+        ints the engine already owns — zero device syncs, and no effect on
+        ``ServeCounters`` (the kv-obs smoke pins byte-identity on vs off)."""
+        if self.kv_obs is None:
+            return
+        free = self.manager.allocator.free_blocks
+        self.kv_obs.refresh(self.manager.seqs, self._kv_steps, free)
+        crossing = self.kv_obs.pressure_crossing()
+        if crossing is not None:
+            edge, ste = crossing
+            self.tracer.event(
+                "kv_pressure", step=self.scheduler.steps, edge=edge,
+                steps_to_exhaustion=None if ste == float("inf") else round(ste, 1),
+                free_blocks=free)
+        if self.tracer.config.chrome_trace_path:
+            # only assemble the counter-track payload when an export will
+            # actually consume it — fragmentation_tokens() walks the census
+            census = self.kv_obs.census
+            ste = self.kv_obs.forecaster.steps_to_exhaustion()
+            self.tracer.counter_track("kv_pool", {
+                "allocated_blocks": census.allocated_blocks,
+                "free_blocks": free,
+                "fragmentation_tokens": census.fragmentation_tokens(),
+                **({} if ste is None else {"steps_to_exhaustion": round(ste, 1)}),
+            })
+
+    def _observe_prefix(self, extra_prompts: Dict[int, List[int]]) -> None:
+        """One PrefixObservatory pass over live + admitted requests: every
+        live sequence contributes its PROMPT portion (generated tokens are
+        never shareable read-only), ``extra_prompts`` the not-yet-admitted
+        prompts of the current intake (queued tickets / a put() batch)."""
+        if self.kv_obs is None:
+            return
+        obs = self.kv_obs.prefix
+        # cache-aware: a live uid whose hashes are already cached passes None
+        # (no token-list slice built) — an intake over a large live set costs
+        # dict lookups, not prompt copies
+        prompts: Dict[int, Optional[List[int]]] = {
+            uid: (None if obs.has(uid) else seq.tokens[:seq.prompt_len])
+            for uid, seq in self.manager.seqs.items() if not seq.done}
+        prompts.update(extra_prompts)
+        obs.observe(prompts)
+
+    def _forget_prefix(self, uid: int) -> None:
+        """Invalidate a uid's PrefixObservatory hash cache for a request that
+        dies WITHOUT ever becoming a live sequence (queue expiry, stall
+        drain, strict-abort drain) — live sequences invalidate through the
+        census's retirement listener, but a queued-only ticket never reaches
+        ``retire()``, and a stale entry would credit the uid's NEXT life with
+        the dead prompt's hashes (phantom sharing)."""
+        if self.kv_obs is not None:
+            self.kv_obs.prefix.forget(uid)
+
+    def check_kv_invariant(self) -> None:
+        """Census-vs-allocator invariant: the census's owned-block set must
+        exactly partition against the allocator free list (no block owned
+        while free, none leaked).  Raises ``CensusInvariantError`` naming the
+        offending uid/block.  Run automatically after every serve pass
+        (``serving_kv_observability.invariant_check``); public so smokes and
+        fault-injection tests can assert it at arbitrary points."""
+        if self.kv_obs is not None:
+            self.kv_obs.check_invariant(self.manager.allocator)
 
     # ---------------------------------------------------------- ops endpoints
     def refresh_ops(self, force: bool = False) -> None:
@@ -599,6 +695,20 @@ class InferenceEngineV2:
                   "fastpath_upload_ints": float(c.upload_ints),
                   "fastpath_burst_fraction":
                       c.burst_tokens / max(c.burst_tokens + c.step_tokens, 1)}
+        if self.kv_obs is not None:
+            # KV-pool gauges (ISSUE 12) under the unified serving_kv_*
+            # spelling — the same names the metrics registry exports, so the
+            # telemetry stream and /metrics can't drift apart again
+            census, fc = self.kv_obs.census, self.kv_obs.forecaster
+            ste = fc.steps_to_exhaustion()
+            gauges.update({
+                "kv_free_blocks": float(self.manager.allocator.free_blocks),
+                "kv_utilization": self.manager.kv_utilization(),
+                "kv_fragmentation_tokens": float(census.fragmentation_tokens()),
+                "kv_alloc_rate": fc.alloc_rate,
+                "kv_free_rate": fc.free_rate,
+                **({} if ste is None else {"kv_steps_to_exhaustion": float(ste)}),
+            })
         # SLO percentile gauges (ISSUE 6): ttft/tbt/e2e/queue_wait p50/p95/p99
         # from the tracer's streaming histograms ({} while tracing is off)
         gauges.update(self.tracer.gauge_fields())
@@ -770,11 +880,11 @@ class InferenceEngineV2:
         except KVAllocationError:
             # an injected/transient allocator failure mid-grab: roll every
             # sequence back to its prior table so nothing is stranded, and
-            # decline — the stepwise fallback retries at finer grain
+            # decline — the stepwise fallback retries at finer grain.  The
+            # rollback rides the manager's reclaim seam so the block census
+            # stays exact through the fault path too.
             for seq, prior in grown:
-                if len(seq.blocks) > prior:
-                    self.manager.allocator.free(seq.blocks[prior:])
-                    seq.blocks = seq.blocks[:prior]
+                self.manager.rollback_blocks(seq, prior)
             return None
 
         n = self._bucket(len(live))
@@ -825,6 +935,8 @@ class InferenceEngineV2:
         # the burst is the dominant emission path: emit the serving gauges
         # here too, so burst-heavy serves surface fresh SLO percentiles and
         # burst-fraction instead of only dispatch-time snapshots
+        self._kv_steps += k
+        self._refresh_kv()
         self._emit_serving_gauges(tokens_run=sum(len(v) for v in out.values()))
         return out
 
@@ -962,9 +1074,20 @@ class InferenceEngineV2:
                         ttl_s=effective, max_new_tokens=max_new_tokens,
                         eos_token_id=eos_token_id, greedy=greedy,
                         prefix_len=len(prefix))
+            # counterfactual prefix-cache report for THIS pass: the queued
+            # (non-shed) prompts joining whatever is already live
+            self._observe_prefix({uid: [int(t) for t in prompt]
+                                  for uid, prompt in zip(uids, prompts)
+                                  if uid not in results})
             self._prewarm(max_new_tokens)
             self._serve_loop(uids, my, results, produced, max_new_tokens=max_new_tokens,
                              eos_token_id=eos_token_id, greedy=greedy, strict=strict)
+            # post-pass pool state: final census/forecast refresh, then the
+            # census-vs-allocator partition invariant (the PR-4 double-free
+            # guard, continuously checked)
+            self._refresh_kv()
+            if self.kv_cfg.invariant_check:
+                self.check_kv_invariant()
         except Exception:
             # a strict-mode raise must not leak this call's queued tickets or
             # live sequences into the next call (they would decode unbounded
@@ -1247,7 +1370,8 @@ class InferenceEngineV2:
                 self.manager.retire(uid, completed=False)
         for uid in my:
             self.manager.failures.pop(uid, None)
-        self.admission.drain()
+        for ticket in self.admission.drain():
+            self._forget_prefix(ticket.uid)  # died queued: retire never fires
         # close any still-open traces of this call so the live-trace map and
         # the strict caller's postmortem both see a terminal event
         self.tracer.abort_all(my, reason="strict-mode abort")
@@ -1346,6 +1470,7 @@ class InferenceEngineV2:
             for t in expired:
                 self.tracer.event("queue_expired", step=self.scheduler.steps,
                                   uid=t.uid)
+                self._forget_prefix(t.uid)  # died queued: retire never fires
                 if t.uid in my and t.uid not in results:
                     self._deadline_expired_total += 1
                     self._record_resilience("serving_deadline_expired", uid=t.uid,
@@ -1420,6 +1545,7 @@ class InferenceEngineV2:
                                         t=self.tracer.last_now)
                 self.manager.retire(uid, completed=False)
         for ticket in self.admission.drain():
+            self._forget_prefix(ticket.uid)  # died queued: retire never fires
             if ticket.uid in my and ticket.uid not in results:
                 results[ticket.uid] = RequestResult(uid=ticket.uid, status=FAILED,
                                                     reason=reason + " (still queued)",
@@ -1475,6 +1601,11 @@ class InferenceEngineV2:
             "num_blocks": alloc.num_blocks,
             "queue_depth": len(self.admission),
             "scheduler_steps": self.scheduler.steps,
+            # block-level pool state (ISSUE 12): the full per-block census
+            # table (owner/age/residency — bounded by the pool size) plus the
+            # rollups/forecast health() carries, for stall postmortems that
+            # need to see WHICH blocks are pinned where
+            "kv": self._kv_snapshot(with_table=True),
             # recovery state (ISSUE 8): restart/recovery counters + journal
             # size, so a crash postmortem's snapshot shows the durability side
             "fault_tolerance": self._fault_tolerance_snapshot(),
@@ -1482,6 +1613,17 @@ class InferenceEngineV2:
             # recorder's tail rides every stall dump for postmortems
             "flight_recorder": self.tracer.recorder.tail(),
         }
+
+    def _kv_snapshot(self, with_table: bool = False) -> Dict[str, Any]:
+        """The ``health()["kv"]`` / ``state_snapshot()["kv"]`` payload:
+        census rollups, prefix-opportunity report, capacity forecast —
+        JSON-safe host values only."""
+        if self.kv_obs is None:
+            return {"enabled": False}
+        snap = self.kv_obs.snapshot(self.manager.allocator.free_blocks)
+        if with_table:
+            snap["census_table"] = self.kv_obs.census.table()
+        return snap
 
     def _fault_tolerance_snapshot(self) -> Dict[str, Any]:
         return {
@@ -1503,6 +1645,10 @@ class InferenceEngineV2:
             "queue_depth": len(self.admission),
             "free_blocks": self.manager.allocator.free_blocks,
             "kv_utilization": self.manager.kv_utilization(),
+            # block-level pool observability (ISSUE 12): census rollups
+            # (fragmentation, block-age, blocks-per-request), counterfactual
+            # prefix-cache opportunity, and the steps-to-exhaustion forecast
+            "kv": self._kv_snapshot(),
             "scheduler_steps": self.scheduler.steps,
             "completed_total": self.manager.completed_requests,
             "failed_total": self.manager.failed_requests,

@@ -82,6 +82,12 @@ class RaggedStateManager:
         self.allocator = BlockedAllocator(num_blocks)
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        # block census (inference/v2/kv_metrics.BlockCensus) — attached by the
+        # engine when kv observability is on.  Hooks fire at the manager's
+        # ONE alloc seam (ensure_blocks) and ONE reclaim seam (_reclaim), so
+        # every path that moves a block keeps the census exact; pure host
+        # bookkeeping, never a device touch.
+        self.census = None
         self.seqs: Dict[int, SequenceDescriptor] = {}
         self.failures: Dict[int, str] = {}
         # uid history for descriptive retire errors; a bounded recency window
@@ -134,7 +140,17 @@ class RaggedStateManager:
             raise RuntimeError(f"uid {seq.uid}: {upto_tokens} tokens exceeds "
                                f"max_blocks_per_seq={self.max_blocks_per_seq}")
         if need > len(seq.blocks):
-            seq.blocks.extend(self.allocator.allocate(need - len(seq.blocks)))
+            grown = self.allocator.allocate(need - len(seq.blocks))
+            seq.blocks.extend(grown)
+            if self.census is not None:
+                self.census.on_alloc(seq.uid, grown)
+
+    def _reclaim(self, uid: int, blocks: List[int]) -> None:
+        """THE reclaim seam: every block leaving a sequence returns to the
+        allocator here, with the census kept in lock-step."""
+        self.allocator.free(blocks)
+        if self.census is not None:
+            self.census.on_free(uid, blocks)
 
     def over_cap(self, upto_tokens: int) -> bool:
         return (upto_tokens + self.block_size - 1) // self.block_size > self.max_blocks_per_seq
@@ -145,7 +161,7 @@ class RaggedStateManager:
         seq = self.seqs.get(uid)
         if seq is not None:
             seq.done = True
-            self.allocator.free(seq.blocks)  # reclaim the KV pool immediately
+            self._reclaim(uid, seq.blocks)  # reclaim the KV pool immediately
             seq.blocks = []
 
     def evict(self, seq: SequenceDescriptor, finish_reason: str) -> None:
@@ -156,7 +172,7 @@ class RaggedStateManager:
         seq.done = True
         seq.finish_reason = finish_reason
         if seq.blocks:
-            self.allocator.free(seq.blocks)
+            self._reclaim(seq.uid, seq.blocks)
             seq.blocks = []
 
     def preempt(self, seq: SequenceDescriptor, keep_blocks: int = 0) -> int:
@@ -165,12 +181,20 @@ class RaggedStateManager:
         KV in the kept blocks stays valid (prefill wrote those positions and
         they are never rewritten); the dropped positions are simply recomputed
         when the sequence is rescheduled.  Returns the number of freed blocks."""
+        dropped = self.rollback_blocks(seq, keep_blocks)
+        seq.seen_tokens = min(seq.seen_tokens, len(seq.blocks) * self.block_size)
+        return dropped
+
+    def rollback_blocks(self, seq: SequenceDescriptor, keep_blocks: int) -> int:
+        """Free a sequence's trailing blocks past ``keep_blocks`` WITHOUT
+        touching its progress — the burst pre-allocation rollback (a failed
+        mid-grab returns exactly the blocks it took) and the lower half of
+        :meth:`preempt`.  Returns the number of freed blocks."""
         keep_blocks = max(0, min(int(keep_blocks), len(seq.blocks)))
         dropped = seq.blocks[keep_blocks:]
         if dropped:
-            self.allocator.free(dropped)
+            self._reclaim(seq.uid, dropped)
             seq.blocks = seq.blocks[:keep_blocks]
-        seq.seen_tokens = min(seq.seen_tokens, keep_blocks * self.block_size)
         return len(dropped)
 
     def can_allocate(self, n_blocks: int) -> bool:
@@ -211,8 +235,10 @@ class RaggedStateManager:
         self.retired_uids[uid] = None
         while len(self.retired_uids) > self._retired_window:
             self.retired_uids.pop(next(iter(self.retired_uids)))
-        self.allocator.free(seq.blocks)
+        self._reclaim(uid, seq.blocks)
         seq.blocks = []
+        if self.census is not None:
+            self.census.on_terminal(uid)
         # neither a flushed failure nor an evicted request is a completion
         if (completed and uid not in self.failures
                 and seq.finish_reason not in EVICTED_FINISH_REASONS):

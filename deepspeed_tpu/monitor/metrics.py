@@ -401,16 +401,107 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
     reg.set_gauge(f"{reg.namespace}_serving_queue_depth",
                   len(engine.admission),
                   help_text="tickets waiting in the admission queue")
-    reg.set_gauge(f"{reg.namespace}_serving_free_kv_blocks",
+    # ---- KV-pool families, unified under ONE serving_kv_* namespace
+    # (ISSUE 12 satellite): the scheduler's and decode_burst's kv-adjacent
+    # gauges used to spell the pool three ways (serving_free_kv_blocks vs
+    # serving_kv_utilization vs scheduler_kv_block_utilization).  Canonical
+    # names below; the old spellings stay as DEPRECATED aliases for one
+    # release so existing dashboards keep scraping.
+    ns_kv = f"{reg.namespace}_serving_kv"
+    reg.set_gauge(f"{ns_kv}_free_blocks",
                   engine.manager.allocator.free_blocks,
                   help_text="free blocks in the paged KV pool")
-    reg.set_gauge(f"{reg.namespace}_serving_kv_utilization",
+    reg.set_gauge(f"{reg.namespace}_serving_free_kv_blocks",
+                  engine.manager.allocator.free_blocks,
+                  help_text="DEPRECATED alias of serving_kv_free_blocks "
+                            "(removed next release)")
+    reg.set_gauge(f"{ns_kv}_utilization",
                   engine.manager.kv_utilization(),
                   help_text="paged KV pool utilization [0, 1]")
+    # block-level observability (ISSUE 12): census, counterfactual prefix-
+    # cache opportunity, capacity forecast — all host ints the engine's
+    # kv_obs already assembled (absent => kv observability disabled)
+    kv_obs = getattr(engine, "kv_obs", None)
+    if kv_obs is not None:
+        census, fc, prefix = kv_obs.census, kv_obs.forecaster, kv_obs.prefix
+        reg.set_gauge(f"{ns_kv}_allocated_blocks", census.allocated_blocks,
+                      help_text="census-owned blocks in the paged KV pool")
+        reg.set_gauge(f"{ns_kv}_fragmentation_tokens",
+                      census.fragmentation_tokens(),
+                      help_text="allocated-but-unfilled token slots "
+                                "(block-granularity + prefill/burst headroom)")
+        reg.set_counter(f"{ns_kv}_blocks_allocated_total",
+                        census.blocks_allocated_total,
+                        help_text="KV blocks allocated (lifetime)")
+        reg.set_counter(f"{ns_kv}_blocks_freed_total",
+                        census.blocks_freed_total,
+                        help_text="KV blocks freed (lifetime)")
+        reg.set_histogram(f"{ns_kv}_block_age_steps", census.age_histogram(),
+                          help_text="serve steps since each live block was "
+                                    "allocated (a fused burst of k counts k)")
+        reg.set_histogram(f"{ns_kv}_block_idle_steps", census.idle_histogram(),
+                          help_text="serve steps since each live block was "
+                                    "last written (cold-block signal)")
+        reg.set_histogram(f"{ns_kv}_blocks_per_request",
+                          census.blocks_per_request,
+                          help_text="peak blocks held per retired request")
+        reg.set_gauge(f"{ns_kv}_prefix_duplicate_blocks",
+                      prefix.last_report["duplicate_blocks"],
+                      help_text="duplicate prompt token-blocks across "
+                                "live+admitted requests (last serve pass)")
+        reg.set_gauge(f"{ns_kv}_prefix_hit_rate",
+                      prefix.last_report["hit_rate"],
+                      help_text="counterfactual prefix-cache hit-rate "
+                                "(last serve pass)")
+        reg.set_counter(f"{ns_kv}_prefix_tokens_saved_total",
+                        prefix.prefill_tokens_saved_total,
+                        help_text="prefill tokens a block-granular prefix "
+                                  "cache would have saved (lifetime)")
+        reg.set_counter(f"{ns_kv}_prefix_passes_total", prefix.passes_total,
+                        help_text="PrefixObservatory passes run")
+        reg.set_gauge(f"{ns_kv}_alloc_rate_blocks_per_step", fc.alloc_rate,
+                      help_text="EWMA block allocation rate per serve step")
+        reg.set_gauge(f"{ns_kv}_free_rate_blocks_per_step", fc.free_rate,
+                      help_text="EWMA block free rate per serve step")
+        ste = fc.steps_to_exhaustion()
+        if ste is not None:
+            # absent while the pool is not trending toward exhaustion — an
+            # inf gauge would render fine on /metrics but poison the per-rank
+            # JSON exchange files (json.dumps emits the non-RFC token
+            # Infinity); absence is the idiomatic "no prediction"
+            reg.set_gauge(f"{ns_kv}_steps_to_exhaustion", ste,
+                          help_text="forecast serve steps until the KV pool "
+                                    "exhausts at current net consumption "
+                                    "(absent while not trending toward "
+                                    "exhaustion) — read next to "
+                                    "serving_shed_total/preempted_total")
+        else:
+            # the ops registry persists across refreshes: a gauge set while
+            # the pool was trending must not linger once the prediction
+            # clears, so the family is dropped, not left stale
+            reg.families.pop(f"{ns_kv}_steps_to_exhaustion", None)
+        reg.set_gauge(f"{ns_kv}_under_pressure",
+                      1.0 if kv_obs.under_pressure else 0.0,
+                      help_text="1 while steps-to-exhaustion is below the "
+                                "configured pressure threshold")
+        reg.set_counter(f"{ns_kv}_invariant_checks_total",
+                        kv_obs.invariant_checks_total,
+                        help_text="census-vs-allocator partition checks run")
     # scheduler per-step gauges (PR 1): queue depth / token occupancy / ...
     for key, value in engine.scheduler.last_gauges.items():
         if key == "preempted_total":
             continue  # already exported as a counter above
+        if key == "kv_block_utilization":
+            # canonical spelling joins the serving_kv_* namespace; the old
+            # scheduler_-prefixed name stays one release as an alias
+            reg.set_gauge(f"{ns_kv}_block_utilization", value,
+                          help_text="paged KV pool utilization at the last "
+                                    "scheduled step")
+            reg.set_gauge(f"{reg.namespace}_scheduler_{key}", value,
+                          help_text="DEPRECATED alias of "
+                                    "serving_kv_block_utilization "
+                                    "(removed next release)")
+            continue
         reg.set_gauge(f"{reg.namespace}_scheduler_{key}", value,
                       help_text="SplitFuse scheduler per-step gauge")
     # fault tolerance (PR 8): restart/recovery counters + journal state

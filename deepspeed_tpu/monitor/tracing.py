@@ -542,6 +542,21 @@ class RequestTracer:
                            **({"args": fields} if fields else {})})
         return events
 
+    def counter_track(self, name: str, values: Dict[str, float]) -> None:
+        """Append a Chrome-trace COUNTER sample (``ph: "C"`` — rendered as a
+        stacked counter track in Perfetto/chrome://tracing) stamped with the
+        last ticked engine time.  Used by the KV-pool observability layer for
+        free-blocks / fragmentation / steps-to-exhaustion tracks alongside
+        the per-request span rows.  A no-op unless a chrome export path is
+        configured, so the always-on path costs one attribute check."""
+        if not self.config.chrome_trace_path or not values:
+            return
+        # counter events key on (pid, name); tid rides along so every buffered
+        # event carries the same field set as the span/instant shapes
+        self._chrome.append({"name": name, "ph": "C", "pid": 0, "tid": 0,
+                             "ts": int(round(self.last_now * 1e6)),
+                             "args": {k: float(v) for k, v in values.items()}})
+
     def write_chrome_trace(self, path: Optional[str] = None) -> Optional[str]:
         """Write buffered chrome events as a trace-event JSON file (load in
         Perfetto or chrome://tracing); returns the path, or None when neither

@@ -595,6 +595,42 @@ class ServingFaultToleranceConfig(ConfigModel):
                              "which exports DSTPU_HEARTBEAT_DIR)")
 
 
+class KVObservabilityConfig(ConfigModel):
+    """Block-level observability over the paged KV pool for the v2 ragged
+    engine (inference/v2/kv_metrics.py — no reference section: the CUDA
+    reference's monitor reports aggregate throughput and has no block-granular
+    pool view; vLLM-class systems treat block bookkeeping as the substrate for
+    prefix caching and eviction policy, which is exactly what this measures
+    ahead of those ROADMAP items).
+
+    ``enabled`` arms the block census (per-block owner/age/residency with
+    utilization, fragmentation and block-age rollups), the
+    ``PrefixObservatory`` (counterfactual prefix-cache win per serve pass:
+    duplicate token-block hashes across live+admitted requests, prefill
+    tokens sharing would have saved, would-be hit-rate), and the capacity
+    forecaster (EWMA block alloc/free rates per iteration yielding a
+    steps-to-exhaustion gauge next to the shed/preempt counters).  Everything
+    reads host-side ints the allocator and ragged manager already own — ZERO
+    device syncs (dslint's host-sync rule scans ``kv_metrics.py`` whole-file,
+    and the kv-obs smoke proves byte-identical fastpath ``ServeCounters``
+    observability on vs off).
+
+    ``invariant_check`` re-verifies after every serve pass that the census's
+    owned-block set exactly partitions against the allocator free list — the
+    PR-4 double-free guard as a continuously-checked pool invariant
+    (``CensusInvariantError`` names the offending uid/block).
+    ``pressure_steps`` is the steps-to-exhaustion threshold below which a
+    ``kv_pressure`` event lands in the flight recorder (edge-triggered:
+    entered/cleared, not once per iteration); ``ewma_alpha`` smooths the
+    forecaster's alloc/free rates.
+    """
+    enabled: bool = True
+    invariant_check: bool = True
+    ewma_alpha: float = Field(0.2, gt=0.0, le=1.0)
+    pressure_steps: float = Field(64.0, gt=0.0)
+    age_buckets_per_decade: int = Field(6, ge=1, le=100)
+
+
 class OpsServerConfig(ConfigModel):
     """Pull-based ops endpoints (monitor/metrics.py + monitor/ops_server.py —
     the PULL counterpart of the reference's push-only ``monitor/`` backends:
@@ -747,6 +783,9 @@ class TrainingConfig(ConfigModel):
     # pull-based ops endpoints (/metrics Prometheus exposition + /healthz +
     # /statez) and per-rank metrics textfiles — same dual-spelling contract
     ops_server: OpsServerConfig = Field(OpsServerConfig)
+    # block-level KV-pool observability (census + prefix-sharing opportunity
+    # + capacity forecast) — same dual-spelling contract as above
+    serving_kv_observability: KVObservabilityConfig = Field(KVObservabilityConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -106,13 +106,14 @@ class HostSyncInHotPath(Rule):
                    "device_get/block_until_ready outside the sanctioned "
                    "fastpath.materialize() deferred-sync helper; in "
                    "runtime/heartbeat.py AND the ops plane (monitor/metrics.py, "
-                   "monitor/exposition.py, monitor/ops_server.py) any explicit "
-                   "device fetch (np.asarray/np.array/device_get/"
+                   "monitor/exposition.py, monitor/ops_server.py) AND the "
+                   "KV-pool observability layer (inference/v2/kv_metrics.py) "
+                   "any explicit device fetch (np.asarray/np.array/device_get/"
                    "block_until_ready/.item) anywhere in the file — liveness "
-                   "stamps and metrics scrapes are contractually "
-                   "zero-device-sync (float() on host config values stays "
-                   "legal there; float-of-device-value isn't statically "
-                   "separable from it)")
+                   "stamps, metrics scrapes and pool census hooks are "
+                   "contractually zero-device-sync (float() on host config "
+                   "values stays legal there; float-of-device-value isn't "
+                   "statically separable from it)")
 
     HOT_NAMES = {"train_batch", "_offload_train_batch", "eval_batch",
                  "decode_burst", "train_step"}
@@ -136,6 +137,12 @@ class HostSyncInHotPath(Rule):
     # error, not a scrape-time surprise
     OPS_PATH_FRAGMENTS = ("monitor/metrics.py", "monitor/exposition.py",
                           "monitor/ops_server.py")
+    # the KV-pool observability layer (ISSUE 12) makes the same promise: the
+    # census/observatory/forecaster read only host ints the allocator and
+    # ragged manager already own, and their hooks run inside the serve loop —
+    # a device fetch here would charge every step a hidden sync, so the whole
+    # file is scanned with the full explicit-fetch set
+    KV_METRICS_PATH_FRAGMENT = "inference/v2/kv_metrics.py"
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -162,6 +169,14 @@ class HostSyncInHotPath(Rule):
                 "scrape handlers and registry adapters are contractually "
                 "zero-device-sync: they read host-side cached snapshots only, "
                 "or every Prometheus poll becomes a hidden device stall")
+            return
+        if relpath.endswith(self.KV_METRICS_PATH_FRAGMENT):
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in inference/v2/kv_metrics.py — the KV-pool census/"
+                "observatory/forecaster are contractually zero-device-sync: "
+                "they consume host ints the allocator and ragged manager "
+                "already own, and their hooks run inside the serve loop")
             return
         in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent

@@ -438,6 +438,111 @@ def ops_smoke():
     return 0
 
 
+def kv_obs_smoke():
+    """CI smoke for KV-pool observability (ISSUE 12 acceptance): (a) a
+    shared-prefix serve must report a NON-ZERO counterfactual prefix-cache
+    win (duplicate blocks, hit-rate, prefill tokens saved) and expose the
+    ``serving_kv_*`` Prometheus families through /metrics (strict-parsed by
+    the in-tree exposition parser); (b) the census-vs-allocator partition
+    invariant must hold through a fault-injected serve (25% probabilistic
+    allocator failures — every alloc/free/preempt/rollback path exercised);
+    (c) zero added host-link cost — the fastpath ``ServeCounters`` are
+    byte-identical with kv observability on vs off, and the tokens match."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.ops_server import scrape
+    from tests.unit.fault_injection_serving import FaultyBlockedAllocator
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    header = rng.integers(1, 128, 24).tolist()  # 3 full shared blocks
+    prompts = [header + rng.integers(1, 128, 4).tolist() for _ in range(6)]
+
+    # ---- (a) shared-prefix serve: counterfactual win + /metrics families
+    on = InferenceEngineV2(llama, cfg, params,
+                           config={"dtype": "float32",
+                                   "ops_server": {"enabled": True,
+                                                  "refresh_interval_s": 0.0}},
+                           **kw)
+    out_on = on.generate(prompts, max_new_tokens=8)
+    kv = on.health()["kv"]
+    assert kv["enabled"], kv
+    pfx = kv["prefix"]
+    assert pfx["duplicate_blocks_total"] > 0, pfx
+    assert pfx["prefill_tokens_saved_total"] > 0, pfx
+    assert pfx["last_pass"]["hit_rate"] > 0.0, pfx
+    assert kv["census"]["blocks_allocated_total"] == \
+        kv["census"]["blocks_freed_total"], kv["census"]  # pool fully reclaimed
+    on.check_kv_invariant()
+    fams = parse_exposition(scrape(on.ops.url("/metrics")))
+    value = lambda name: fams[name]["samples"][0][2]
+    assert value("dstpu_serving_kv_prefix_tokens_saved_total") == \
+        pfx["prefill_tokens_saved_total"]
+    assert value("dstpu_serving_kv_free_blocks") == value("dstpu_serving_free_kv_blocks")
+    for name in ("dstpu_serving_kv_utilization", "dstpu_serving_kv_fragmentation_tokens",
+                 "dstpu_serving_kv_under_pressure",
+                 "dstpu_serving_kv_block_utilization"):
+        assert name in fams, f"missing /metrics family {name}"
+    # absent while idle by design: an inf gauge would poison the JSON
+    # exchange files (it appears finite while trending toward exhaustion)
+    assert "dstpu_serving_kv_steps_to_exhaustion" not in fams
+    for name in ("dstpu_serving_kv_block_age_steps",
+                 "dstpu_serving_kv_blocks_per_request"):
+        assert fams[name]["type"] == "histogram", name
+    on.close_ops()
+
+    # ---- (c) byte-identical ServeCounters + tokens, kv observability off
+    off = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32",
+                                    "serving_kv_observability": {"enabled": False}},
+                            **kw)
+    out_off = off.generate(prompts, max_new_tokens=8)
+    assert out_on == out_off, "kv observability changed the served tokens"
+    c_on, c_off = on.counters.snapshot(), off.counters.snapshot()
+    assert c_on == c_off, \
+        f"kv observability disturbed the host-link counters: {c_on} vs {c_off}"
+    assert off.health()["kv"] == {"enabled": False}
+
+    # ---- (b) census invariant under injected allocator faults (the PR-4
+    # double-free guard as a continuously-checked pool invariant)
+    faulty = InferenceEngineV2(llama, cfg, params,
+                               config={"dtype": "float32",
+                                       "serving_resilience": {"max_live_seqs": 3,
+                                                              "stall_watchdog_steps": 50}},
+                               num_blocks=48, block_size=8, max_blocks_per_seq=8,
+                               token_budget=32, max_seqs_per_step=4)
+    faulty.manager.allocator = FaultyBlockedAllocator(48, fail_rate=0.25, seed=11)
+    mixed = [rng.integers(1, 128, int(n)).tolist() for n in rng.integers(3, 24, 8)]
+    results = faulty.generate(mixed, max_new_tokens=6, strict=False)
+    assert all(r.status == "ok" for r in results), [r.status for r in results]
+    assert faulty.manager.allocator.injected_failures > 0, "faults never fired"
+    faulty.check_kv_invariant()  # owned-set/free-list partition held throughout
+    census = faulty.health()["kv"]["census"]
+    assert census["allocated_blocks"] == 0 and \
+        census["blocks_allocated_total"] == census["blocks_freed_total"], census
+
+    print(json.dumps({"kv_obs_smoke": "ok", "requests": len(prompts),
+                      "duplicate_blocks_total": pfx["duplicate_blocks_total"],
+                      "hit_rate": round(pfx["last_pass"]["hit_rate"], 4),
+                      "prefill_tokens_saved": pfx["prefill_tokens_saved_total"],
+                      "injected_failures": faulty.manager.allocator.injected_failures,
+                      "invariant_checks":
+                          faulty.health()["kv"]["invariant_checks_total"],
+                      "host_syncs": c_on["host_syncs"]}))
+    return 0
+
+
 def elastic_smoke():
     """CI smoke for elastic training fault tolerance (ISSUE 7 acceptance):
     a 4-worker CPU run under the elastic agent with TWO injected faults —
@@ -900,6 +1005,7 @@ def main():
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
              run_smoke_lane("ops_smoke", "--ops-smoke"),
+             run_smoke_lane("kv_obs_smoke", "--kv-obs-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_drift_families_lane(),
@@ -924,6 +1030,8 @@ if __name__ == "__main__":
         sys.exit(tracing_smoke())
     if "--ops-smoke" in sys.argv:
         sys.exit(ops_smoke())
+    if "--kv-obs-smoke" in sys.argv:
+        sys.exit(kv_obs_smoke())
     if "--serving-recovery-smoke" in sys.argv:
         sys.exit(serving_recovery_smoke())
     if "--elastic-smoke" in sys.argv:

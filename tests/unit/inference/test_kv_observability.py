@@ -1,0 +1,490 @@
+"""KV-pool observability suite (ISSUE 12): block census lifecycle, the
+census-vs-allocator partition invariant (incl. under injected allocator
+faults), PrefixObservatory duplicate detection, capacity-forecaster
+convergence, and the zero-added-cost guarantee (byte-identical fastpath
+``ServeCounters`` with observability on vs off).  Everything runs on the CPU
+backend; census ages are scheduler steps so every quantile assertion is
+exact."""
+
+import json
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2 import (BlockCensus, CapacityForecaster,
+                                        CensusInvariantError, InferenceEngineV2,
+                                        KVObservability, PrefixObservatory,
+                                        RaggedStateManager, block_hashes)
+from deepspeed_tpu.models import llama
+from tests.unit.fault_injection_serving import FakeClock, FaultyBlockedAllocator
+
+BS = 8  # block size every manager/census in this file uses
+
+
+def make_manager(num_blocks=32, max_blocks=8, with_census=True):
+    m = RaggedStateManager(num_blocks, BS, max_blocks)
+    if with_census:
+        m.census = BlockCensus(BS, num_blocks, m.trash_block)
+    return m
+
+
+def tiny_engine(config=None, **overrides):
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=BS, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    kw.update(overrides)
+    return InferenceEngineV2(llama, cfg, params,
+                             config={"dtype": "float32", **(config or {})}, **kw)
+
+
+# ----------------------------------------------------------- census lifecycle
+def test_census_tracks_alloc_and_retire():
+    m = make_manager()
+    seq = m.add_sequence(0, list(range(1, 20)))  # 19 tokens -> 3 blocks
+    m.ensure_blocks(seq, len(seq.tokens))
+    census = m.census
+    assert census.allocated_blocks == 3
+    assert sorted(census.blocks) == sorted(seq.blocks)
+    assert all(rec.uid == 0 for rec in census.blocks.values())
+    assert census.blocks_allocated_total == 3
+    m.retire(0)
+    assert census.allocated_blocks == 0
+    assert census.blocks_freed_total == 3
+    # peak blocks sampled into the per-request distribution at retirement
+    assert census.blocks_per_request.count == 1
+    assert census.blocks_per_request.max_seen == 3.0
+
+
+def test_census_residency_and_fragmentation_refresh():
+    m = make_manager()
+    seq = m.add_sequence(0, list(range(1, 20)))  # 19 tokens
+    m.ensure_blocks(seq, 19)
+    seq.seen_tokens = 10  # 8 resident in block 0, 2 in block 1, 0 in block 2
+    m.census.refresh(m.seqs, step=4)
+    assert m.census.tokens_resident() == 10
+    assert m.census.fragmentation_tokens() == 3 * BS - 10
+    recs = [m.census.blocks[b] for b in seq.blocks]
+    assert [r.tokens_resident for r in recs] == [8, 2, 0]
+    # only the blocks whose residency CHANGED got a fresh touch stamp
+    assert [r.last_touched_step for r in recs] == [4, 4, 0]
+
+
+def test_census_block_age_quantiles_exact_under_fake_clock():
+    """Ages are scheduler steps, so a FakeClock-driven engine (no wall time
+    anywhere) asserts EXACT quantiles: the histogram's deterministic bucket
+    representatives."""
+    census = BlockCensus(BS, 32, 31)
+    census.step = 0
+    census.on_alloc(0, [0, 1])
+    census.step = 8
+    census.on_alloc(1, [2])
+    census.step = 10
+    hist = census.age_histogram()
+    assert hist.count == 3
+    # ages: 10, 10, 2 -> p50 = representative(index(10)), min bucket edges
+    # are deterministic functions of (bpd=6, min=1.0)
+    assert hist.quantile(0.5) == hist.representative(hist._index(10.0))
+    assert hist.quantile(0.01) == hist.representative(hist._index(2.0))
+    # idle stamps: block 2 untouched since step 8
+    idle = census.idle_histogram()
+    assert idle.count == 3 and idle.max_seen == 10.0
+
+
+def test_census_preempt_and_evict_paths():
+    m = make_manager()
+    victim = m.add_sequence(0, list(range(1, 33)))  # 32 tokens -> 4 blocks
+    m.ensure_blocks(victim, 32)
+    victim.seen_tokens = 32
+    assert m.census.allocated_blocks == 4
+    freed = m.preempt(victim, keep_blocks=2)
+    assert freed == 2
+    assert m.census.allocated_blocks == 2
+    assert sorted(m.census.blocks) == sorted(victim.blocks)
+    m.evict(victim, "deadline_expired")
+    assert m.census.allocated_blocks == 0
+    # peak (4 blocks) is sampled at RETIREMENT, not at the eviction free
+    assert m.census.blocks_per_request.count == 0
+    m.retire(0, completed=False)
+    assert m.census.blocks_per_request.count == 1
+    assert m.census.blocks_per_request.max_seen == 4.0
+
+
+def test_census_fail_path_keeps_partition():
+    m = make_manager()
+    seq = m.add_sequence(7, list(range(1, 10)))
+    m.ensure_blocks(seq, 9)
+    m.fail(7, "injected")
+    m.census.check_against(m.allocator)  # blocks freed AND census emptied
+    m.retire(7)  # flush the failure entry
+    m.census.check_against(m.allocator)
+
+
+# ------------------------------------------------------------------ invariant
+def test_invariant_names_double_freed_block_and_uid():
+    m = make_manager()
+    seq = m.add_sequence(3, list(range(1, 20)))
+    m.ensure_blocks(seq, 19)
+    # manufacture the aliasing state: a block both census-owned and free
+    stolen = seq.blocks[1]
+    m.allocator.free([stolen])
+    with pytest.raises(CensusInvariantError) as exc:
+        m.census.check_against(m.allocator)
+    assert exc.value.block == stolen and exc.value.uid == 3
+    assert "double-free" in str(exc.value)
+
+
+def test_invariant_names_leaked_block():
+    m = make_manager()
+    seq = m.add_sequence(3, list(range(1, 10)))
+    m.ensure_blocks(seq, 9)
+    leaked = seq.blocks[0]
+    m.census.on_free(3, [leaked])  # census forgets, allocator still has it out
+    with pytest.raises(CensusInvariantError) as exc:
+        m.census.check_against(m.allocator)
+    assert exc.value.block == leaked and "leaked" in str(exc.value)
+
+
+def test_invariant_holds_through_fault_injected_serve():
+    """The smoke's core assertion as a unit test: 25% probabilistic allocator
+    failures drive every alloc/free/preempt/burst-rollback path, and the
+    owned-set/free-list partition must hold at the end of every pass."""
+    eng = tiny_engine(config={"serving_resilience": {"max_live_seqs": 3,
+                                                     "stall_watchdog_steps": 50}},
+                      num_blocks=48, max_seqs_per_step=4)
+    eng.manager.allocator = FaultyBlockedAllocator(48, fail_rate=0.25, seed=11)
+    import numpy as np
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 128, int(n)).tolist() for n in rng.integers(3, 24, 8)]
+    results = eng.generate(prompts, max_new_tokens=6, strict=False)
+    assert all(r.status == "ok" for r in results)
+    assert eng.manager.allocator.injected_failures > 0
+    eng.check_kv_invariant()
+    census = eng.health()["kv"]["census"]
+    assert census["allocated_blocks"] == 0
+    assert census["blocks_allocated_total"] == census["blocks_freed_total"]
+
+
+def test_serve_pass_invariant_check_raises_on_corruption():
+    """The per-pass automatic check actually fires: corrupt the pool between
+    passes and the next generate() must raise the structured error."""
+    eng = tiny_engine()
+    eng.generate([[1, 2, 3]], max_new_tokens=2)
+    eng.put([50], [list(range(1, 18))])
+    eng.step()
+    seq = eng.manager.seqs[50]
+    eng.manager.allocator.free([seq.blocks[0]])  # alias seq's block as free
+    with pytest.raises(CensusInvariantError):
+        eng.generate([[4, 5, 6]], max_new_tokens=2)
+
+
+# ---------------------------------------------------------- prefix observatory
+def test_block_hashes_chain_on_ancestry():
+    a = block_hashes(list(range(24)), BS)
+    b = block_hashes(list(range(24)), BS)
+    assert a == b and len(a) == 3
+    # divergence in block 0 changes EVERY downstream hash (chained keying)
+    c = block_hashes([99] + list(range(1, 24)), BS)
+    assert all(x != y for x, y in zip(a, c))
+    # identical tail blocks after divergent heads must NOT collide
+    d = block_hashes(list(range(8, 24)), BS)  # same tokens as a's blocks 1-2
+    assert set(a[1:]).isdisjoint(d)
+    # partial trailing block contributes no hash
+    assert len(block_hashes(list(range(23)), BS)) == 2
+
+
+def test_prefix_observatory_counts_shared_headers():
+    obs = PrefixObservatory(BS)
+    header = list(range(100, 124))  # 3 full blocks
+    report = obs.observe({0: header + [1], 1: header + [2], 2: header + [3]})
+    assert report["prompt_blocks"] == 9
+    assert report["unique_blocks"] == 3
+    assert report["duplicate_blocks"] == 6
+    assert report["prefill_tokens_saved"] == 6 * BS
+    assert report["hit_rate"] == pytest.approx(6 / 9)
+    assert obs.prefill_tokens_saved_total == 6 * BS
+
+
+def test_prefix_observatory_zero_false_sharing_on_divergent_prompts():
+    obs = PrefixObservatory(BS)
+    # same multiset of tokens, different first token: nothing shareable
+    report = obs.observe({0: list(range(24)), 1: [99] + list(range(1, 24)),
+                          2: list(range(50, 74))})
+    assert report["duplicate_blocks"] == 0
+    assert report["hit_rate"] == 0.0
+    assert report["prefill_tokens_saved"] == 0
+
+
+def test_engine_reports_counterfactual_win_on_shared_prefix_serve():
+    eng = tiny_engine()
+    header = list(range(1, 25))  # 3 full shared blocks
+    prompts = [header + [100 + i] for i in range(4)]
+    eng.generate(prompts, max_new_tokens=4)
+    pfx = eng.health()["kv"]["prefix"]
+    assert pfx["duplicate_blocks_total"] > 0
+    assert pfx["prefill_tokens_saved_total"] > 0
+    assert pfx["last_pass"]["hit_rate"] > 0.0
+    # and a divergent-prompt serve reports zero sharing for its pass
+    eng.generate([[10 + i, 20 + i, 30 + i] for i in range(3)], max_new_tokens=2)
+    assert eng.kv_obs.prefix.last_report["duplicate_blocks"] == 0
+
+
+# ------------------------------------------------------------------ forecaster
+def test_forecaster_converges_to_constant_rates():
+    fc = CapacityForecaster(alpha=0.3)
+    allocs = frees = 0
+    free_blocks = 1000
+    for _ in range(120):  # constant synthetic load: +5 alloc, +2 free per iter
+        allocs += 5
+        frees += 2
+        free_blocks -= 3
+        fc.update(allocs, frees, free_blocks)
+    assert fc.alloc_rate == pytest.approx(5.0, abs=1e-6)
+    assert fc.free_rate == pytest.approx(2.0, abs=1e-6)
+    assert fc.net_rate == pytest.approx(3.0, abs=1e-6)
+    assert fc.steps_to_exhaustion() == pytest.approx(free_blocks / 3.0, rel=1e-6)
+
+
+def test_prefix_lifetime_totals_charge_each_request_once():
+    """Re-observing a still-live request on a later pass must add NOTHING to
+    the lifetime totals — otherwise the 'counterfactual win' overstates what
+    a real prefix cache could save and becomes an unreachable A/B gate."""
+    obs = PrefixObservatory(BS)
+    header = list(range(100, 124))  # 3 full blocks
+    obs.observe({0: header + [1], 1: header + [2]})  # wave 1: 3 dup blocks
+    assert obs.duplicate_blocks_total == 3
+    # wave 2: both wave-1 requests still live, one new request joins
+    obs.observe({0: header + [1], 1: header + [2], 2: header + [3]})
+    # only the NEW request's 3 header blocks count; survivors add nothing
+    assert obs.duplicate_blocks_total == 6
+    assert obs.prompt_blocks_total == 9  # 3 requests x 3 blocks, each once
+    assert obs.prefill_tokens_saved_total == 6 * BS
+    # the instantaneous last_pass still shows the full live-set duplication
+    assert obs.last_report["duplicate_blocks"] == 6
+    # wave 3: same live set again — totals frozen
+    obs.observe({0: header + [1], 1: header + [2], 2: header + [3]})
+    assert obs.duplicate_blocks_total == 6 and obs.prompt_blocks_total == 9
+
+
+def test_prefix_lifetime_charges_reused_uid_as_new_request():
+    """generate() numbers requests 0..n-1 every call, so a retired uid comes
+    back as a brand-new request — possibly with an identical prompt.  The
+    terminal listener must invalidate the hash cache so the new life is
+    charged to the lifetime counters (a stale cache hit would silently skip
+    it and under-report the scenario's counterfactual win)."""
+    kv = KVObservability(BS, 32, 31)
+    header = list(range(100, 124))
+    kv.prefix.observe({0: header + [1], 1: header + [2]})
+    assert kv.prefix.duplicate_blocks_total == 3
+    kv.census.on_terminal(0)
+    kv.census.on_terminal(1)
+    # same uids, same prompts — a NEW serve of the same workload
+    kv.prefix.observe({0: header + [1], 1: header + [2]})
+    assert kv.prefix.duplicate_blocks_total == 6
+    assert kv.prefix.prompt_blocks_total == 12
+    # engine-level: two identical generate() calls accrue identical deltas
+    eng = tiny_engine()
+    prompts = [header + [100 + i] for i in range(3)]
+    eng.generate(prompts, max_new_tokens=2)
+    first = eng.kv_obs.prefix.prefill_tokens_saved_total
+    assert first > 0
+    eng.generate(prompts, max_new_tokens=2)
+    assert eng.kv_obs.prefix.prefill_tokens_saved_total == 2 * first
+
+
+def test_queue_expired_ticket_does_not_poison_prefix_cache():
+    """A ticket that dies IN THE QUEUE never reaches retire(), so the
+    census's terminal listener can't invalidate its hash cache — the engine
+    must forget it at the queue-death seam, or the uid's next life is scored
+    with the dead prompt's hashes (phantom sharing)."""
+    clock = FakeClock(tick=0.01)
+    eng = tiny_engine(clock=clock,
+                      config={"serving_resilience": {"max_live_seqs": 1}})
+    dead_prompt = list(range(1, 25))  # 3 full blocks
+    results = {r.uid: r for r in eng.generate([[1, 2, 3], dead_prompt],
+                                              max_new_tokens=12, strict=False,
+                                              ttl_s=0.05)}
+    assert results[1].status == "deadline_expired"
+    assert "queue" in (results[1].reason or ""), results[1].reason
+    # uid 1 comes back with a DIVERGENT prompt while uid 0 takes the dead
+    # prompt: a stale cache entry for uid 1 would phantom-match uid 0
+    eng.generate([dead_prompt, [100 + i for i in range(24)]], max_new_tokens=2)
+    assert eng.kv_obs.prefix.last_report["duplicate_blocks"] == 0
+
+
+def test_census_resident_total_is_incrementally_exact():
+    """fragmentation_tokens() is O(1) off a running total — it must agree
+    with a full walk through grow/refresh/preempt/free churn."""
+    m = make_manager()
+    s0 = m.add_sequence(0, list(range(1, 20)))
+    s1 = m.add_sequence(1, list(range(1, 12)))
+    m.ensure_blocks(s0, 19)
+    m.ensure_blocks(s1, 11)
+    s0.seen_tokens, s1.seen_tokens = 13, 11
+    m.census.refresh(m.seqs, step=1)
+    walk = sum(r.tokens_resident for r in m.census.blocks.values())
+    assert m.census.tokens_resident() == walk == 24
+    m.preempt(s0, keep_blocks=1)  # drops resident tokens with the blocks
+    m.census.refresh(m.seqs, step=2)
+    walk = sum(r.tokens_resident for r in m.census.blocks.values())
+    assert m.census.tokens_resident() == walk
+    m.retire(1)
+    m.evict(s0, "deadline_expired")
+    m.retire(0, completed=False)
+    assert m.census.tokens_resident() == 0
+    assert m.census.fragmentation_tokens() == 0
+
+
+def test_census_tracks_peak_fragmentation():
+    m = make_manager()
+    seq = m.add_sequence(0, list(range(1, 20)))  # 19 tokens -> 3 blocks
+    m.ensure_blocks(seq, 19)
+    seq.seen_tokens = 10
+    m.census.refresh(m.seqs, step=1)
+    assert m.census.peak_fragmentation_tokens == 3 * BS - 10
+    assert m.census.peak_allocated_blocks == 3
+    m.retire(0)
+    m.census.refresh(m.seqs, step=2)
+    # pool drained: point-in-time reads 0, the peaks keep the signal
+    assert m.census.fragmentation_tokens() == 0
+    assert m.census.rollup(m.allocator.free_blocks)[
+        "peak_fragmentation_tokens"] == 3 * BS - 10
+
+
+def test_forecaster_normalizes_rates_to_serve_steps():
+    """A fused decode burst advances the serve-step clock by k in ONE update;
+    the per-step rates (and therefore steps-to-exhaustion) must match a
+    stepwise serve of the same workload."""
+    fc = CapacityForecaster(alpha=1.0)
+    fc.update(0, 0, 100, step=0)
+    fc.update(16, 0, 84, step=16)  # one burst: 16 blocks over 16 steps
+    assert fc.alloc_rate == pytest.approx(1.0)
+    assert fc.steps_to_exhaustion() == pytest.approx(84.0)
+
+
+def test_forecaster_none_when_not_trending_to_exhaustion():
+    fc = CapacityForecaster(alpha=0.5)
+    fc.update(4, 4, 100)
+    fc.update(8, 8, 100)  # alloc == free: net 0
+    assert fc.steps_to_exhaustion() is None
+    snap = fc.snapshot()
+    assert snap["steps_to_exhaustion"] is None  # JSON-safe (no inf)
+    json.dumps(snap)
+
+
+def test_pressure_crossing_is_edge_triggered():
+    kv = KVObservability(BS, 32, 31, ewma_alpha=1.0, pressure_steps=10.0)
+    kv.forecaster.update(0, 0, 30)
+    kv.forecaster.update(6, 0, 24)  # 6 blocks/iter against 24 free: ste = 4
+    edge, ste = kv.pressure_crossing()
+    assert edge == "entered" and ste == pytest.approx(4.0)
+    assert kv.pressure_crossing() is None      # still pressured: no re-fire
+    kv.forecaster.update(12, 6, 24)            # alloc 6, free 6: net 0
+    edge, _ = kv.pressure_crossing()
+    assert edge == "cleared"
+    assert kv.pressure_crossing() is None      # still clear: no re-fire
+    assert kv.pressure_events_total == 1
+
+
+def test_engine_pressure_event_lands_in_flight_recorder():
+    eng = tiny_engine(num_blocks=32, config={
+        "serving_kv_observability": {"pressure_steps": 1000.0}})
+    eng.generate([list(range(1, 20)) for _ in range(3)], max_new_tokens=6)
+    events = [e for e in eng.tracer.recorder.tail() if e["event"] == "kv_pressure"]
+    assert events, "no kv_pressure event despite a huge threshold"
+    assert events[0]["edge"] == "entered"
+    json.dumps(events)  # recorder entries stay JSON-safe (no inf leaks)
+
+
+# -------------------------------------------------- zero-added-cost guarantee
+def test_serve_counters_byte_identical_kv_obs_on_vs_off():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    header = rng.integers(1, 128, 16).tolist()
+    prompts = [header + rng.integers(1, 128, 4).tolist() for _ in range(5)]
+    on = tiny_engine()
+    off = tiny_engine(config={"serving_kv_observability": {"enabled": False}})
+    out_on = on.generate(prompts, max_new_tokens=8)
+    out_off = off.generate(prompts, max_new_tokens=8)
+    assert out_on == out_off
+    assert on.counters.snapshot() == off.counters.snapshot()
+    assert on.health()["kv"]["enabled"] and off.health()["kv"] == {"enabled": False}
+    assert off.manager.census is None
+
+
+def test_kv_sections_are_json_safe_and_mirrored():
+    eng = tiny_engine()
+    eng.generate([list(range(1, 20)) for _ in range(3)], max_new_tokens=4)
+    eng.put([77], [list(range(1, 12))])
+    eng.step()  # live mid-flight state in the snapshot
+    health_kv = eng.health()["kv"]
+    snap_kv = eng.state_snapshot()["kv"]
+    json.dumps(health_kv)
+    json.dumps(snap_kv)
+    assert "census_table" in snap_kv and "census_table" not in health_kv
+    held = {b for s in eng.manager.seqs.values() for b in s.blocks}
+    assert set(snap_kv["census_table"]) == held
+    for rec in snap_kv["census_table"].values():
+        assert set(rec) == {"uid", "allocated_step", "last_touched_step",
+                            "tokens_resident"}
+    eng.flush(77)
+
+
+def test_registry_exports_unified_serving_kv_families():
+    from deepspeed_tpu.monitor.exposition import parse_exposition, render
+    from deepspeed_tpu.monitor.metrics import MetricsRegistry, populate_from_engine
+    eng = tiny_engine()
+    header = list(range(1, 25))
+    eng.generate([header + [i] for i in range(3)], max_new_tokens=4)
+    reg = MetricsRegistry()
+    populate_from_engine(reg, eng)
+    fams = parse_exposition(render(reg, collect=False))
+    value = lambda n: fams[n]["samples"][0][2]
+    # canonical spelling and the one-release deprecated aliases agree
+    assert value("dstpu_serving_kv_free_blocks") == value("dstpu_serving_free_kv_blocks")
+    assert value("dstpu_serving_kv_block_utilization") == \
+        value("dstpu_scheduler_kv_block_utilization")
+    assert "DEPRECATED" in fams["dstpu_serving_free_kv_blocks"]["help"]
+    assert "DEPRECATED" in fams["dstpu_scheduler_kv_block_utilization"]["help"]
+    assert value("dstpu_serving_kv_prefix_tokens_saved_total") > 0
+    assert fams["dstpu_serving_kv_blocks_per_request"]["type"] == "histogram"
+    # steps_to_exhaustion is ABSENT while the pool is idle (an inf gauge
+    # would poison the per-rank JSON exchange files) and appears finite the
+    # moment the forecaster trends toward exhaustion
+    assert "dstpu_serving_kv_steps_to_exhaustion" not in fams
+    fc = eng.kv_obs.forecaster
+    fc.alloc_rate, fc.free_rate, fc.free_blocks = 5.0, 1.0, 40
+    reg2 = MetricsRegistry()
+    populate_from_engine(reg2, eng)
+    fams2 = parse_exposition(render(reg2, collect=False))
+    ste = fams2["dstpu_serving_kv_steps_to_exhaustion"]["samples"][0][2]
+    assert ste == pytest.approx(10.0)
+
+
+def test_chrome_counter_track_emitted(tmp_path):
+    path = str(tmp_path / "trace.json")
+    eng = tiny_engine(config={"serving_tracing": {"enabled": True,
+                                                  "chrome_trace_path": path}},
+                      clock=FakeClock(tick=0.01))
+    eng.generate([list(range(1, 20)) for _ in range(3)], max_new_tokens=4)
+    with open(path) as fh:
+        events = json.load(fh)["traceEvents"]
+    tracks = [e for e in events if e.get("ph") == "C" and e["name"] == "kv_pool"]
+    assert tracks, "no kv_pool counter-track samples in the chrome trace"
+    args = tracks[0]["args"]
+    assert {"allocated_blocks", "free_blocks", "fragmentation_tokens"} <= set(args)
+
+
+def test_burst_rollback_rides_the_census_seam():
+    """A failed burst pre-allocation must return exactly the blocks it took,
+    with the census in lock-step (the fault path the invariant guards)."""
+    m = make_manager(num_blocks=16, max_blocks=16)
+    seq = m.add_sequence(0, list(range(1, 9)))
+    m.ensure_blocks(seq, 8)
+    prior = len(seq.blocks)
+    m.ensure_blocks(seq, 40)  # burst-style pre-grab
+    assert len(seq.blocks) > prior
+    m.rollback_blocks(seq, prior)
+    assert len(seq.blocks) == prior
+    m.census.check_against(m.allocator)
