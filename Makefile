@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -89,6 +89,15 @@ ops-smoke:
 # byte-identical with kv observability on vs off (zero added device syncs)
 kv-obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --kv-obs-smoke
+
+# copy-on-write prefix caching (ISSUE 13): a shared-prefix arrival run must
+# realize a hit-rate > 0 with prefill tokens saved EQUAL to the
+# PrefixObservatory's counterfactual prediction, serve tokens byte-identical
+# cache on vs off, fully reclaim the pool AND the tree at drain (refcount +
+# census invariants clean, incl. under 25% injected allocator faults), and
+# leave the fastpath ServeCounters byte-identical on a no-sharing workload
+prefix-cache-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --prefix-cache-smoke
 
 # serving fault tolerance (ISSUE 8): kill a real serving worker mid-decode;
 # supervised restart + journal replay must bring every request to a terminal

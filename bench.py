@@ -914,13 +914,15 @@ def _kv_report(prefix: str, eng):
 
 
 def measure_serving_shared_prefix(on_tpu: bool):
-    """Shared-prefix arrival scenario (ISSUE 12; the ROADMAP prefix-cache
-    benchmark): every request carries the same system-prompt/few-shot header
+    """Shared-prefix A/B (ISSUE 13; formerly the ISSUE 12 counterfactual-only
+    scenario): every request carries the same system-prompt/few-shot header
     plus a short unique tail — the dominant real-traffic shape prefix caching
-    exists for.  Reports the COUNTERFACTUAL win the PrefixObservatory
-    measures (duplicate blocks, prefill tokens a block-granular prefix cache
-    would have saved, would-be hit-rate) alongside throughput, so when
-    copy-on-write sharing lands, this same scenario becomes its A/B gate."""
+    exists for.  The identical arrival scenario runs with the copy-on-write
+    prefix cache ON and OFF, reporting tok/s and TTFT p50/p95 for both legs
+    (PR-6 tracer histograms), the REALIZED hit-rate / prefill tokens saved /
+    CoW copies, counterfactual-vs-realized agreement against the
+    PrefixObservatory's prediction, and whether the generated tokens were
+    byte-identical between the legs."""
     import jax
 
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -936,41 +938,89 @@ def measure_serving_shared_prefix(on_tpu: bool):
         n_req, header_len, tail_len, max_new = 6, 24, 4, 4
         num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 16, 64, 8
 
-    eng = InferenceEngineV2(llama, cfg, llama.init_params(cfg, jax.random.PRNGKey(0)),
-                            config={"dtype": "bfloat16" if on_tpu else "float32"},
-                            num_blocks=num_blocks, block_size=block_size,
-                            max_blocks_per_seq=maxb, token_budget=budget,
-                            max_seqs_per_step=max_seqs)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def build(cache_on: bool):
+        return InferenceEngineV2(
+            llama, cfg, params,
+            config={"dtype": "bfloat16" if on_tpu else "float32",
+                    "serving_tracing": {"enabled": True},
+                    "serving_prefix_cache": {"enabled": cache_on}},
+            num_blocks=num_blocks, block_size=block_size,
+            max_blocks_per_seq=maxb, token_budget=budget,
+            max_seqs_per_step=max_seqs)
+
     rng = np.random.default_rng(0)
     header = rng.integers(1, cfg.vocab_size, header_len).tolist()
     prompts = [header + rng.integers(1, cfg.vocab_size, tail_len).tolist()
                for _ in range(n_req)]
     # same three-wave arrival shape as serving_mixed: later waves land while
-    # earlier ones decode, so the observatory sees live+admitted overlap
+    # earlier ones decode, so the observatory sees live+admitted overlap AND
+    # the tree serves cross-wave hits
     arrivals = {0: list(range(n_req // 2)),
                 n_req // 4 + 4: list(range(n_req // 2, 3 * n_req // 4)),
                 n_req // 4 + 8: list(range(3 * n_req // 4, n_req))}
-    _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
-    # scenario-delta accounting: the observatory's totals are lifetime
-    # counters, so the warm run's passes must be subtracted out — the
-    # reported win is exactly the MEASURED scenario's, the number the
-    # ROADMAP copy-on-write item must realize (and will be A/B'd against)
-    warm = eng.health()["kv"]["prefix"]
-    tokens, dt, lats, hit_stall, _ = _run_serving_scenario(eng, prompts, arrivals, max_new)
-    kv = eng.health()["kv"]
-    d_dup = kv["prefix"]["duplicate_blocks_total"] - warm["duplicate_blocks_total"]
-    d_blocks = kv["prefix"]["prompt_blocks_total"] - warm["prompt_blocks_total"]
-    d_saved = (kv["prefix"]["prefill_tokens_saved_total"]
-               - warm["prefill_tokens_saved_total"])
-    return {"shared_prefix_tok_s": round(tokens / max(dt, 1e-9), 1),
-            "shared_prefix_requests": n_req,
-            "shared_prefix_header_tokens": header_len,
-            "shared_prefix_duplicate_blocks": d_dup,
-            "shared_prefix_hit_rate": round(d_dup / max(d_blocks, 1), 4),
-            "shared_prefix_prefill_tokens_saved": d_saved,
-            "shared_prefix_peak_fragmentation_tokens":
-                kv["census"]["peak_fragmentation_tokens"],
-            "shared_prefix_stalled": bool(hit_stall)}
+
+    legs = {}
+    out = {"shared_prefix_requests": n_req,
+           "shared_prefix_header_tokens": header_len}
+    for cache_on in (True, False):
+        eng = build(cache_on)
+        _run_serving_scenario(eng, prompts, arrivals, max_new)  # warm: compile buckets
+        eng.tracer.reset_histograms()
+        # scenario-delta accounting: observatory/tree totals are lifetime
+        # counters, so the warm run's passes are subtracted out — the
+        # reported win is exactly the MEASURED scenario's
+        warm_obs = eng.health()["kv"]["prefix"]
+        warm_pc = eng.health()["prefix_cache"]
+        tokens, dt, lats, hit_stall, _ = _run_serving_scenario(
+            eng, prompts, arrivals, max_new)
+        pct = eng.tracer.percentiles()
+        obs = eng.health()["kv"]["prefix"]
+        pc = eng.health()["prefix_cache"]
+        leg = "cache_on" if cache_on else "cache_off"
+        legs[cache_on] = eng
+        ms = lambda v: round(v * 1e3, 2)
+        out[f"shared_prefix_{leg}_tok_s"] = round(tokens / max(dt, 1e-9), 1)
+        for k in ("p50", "p95"):
+            ttft = (pct.get("ttft") or {}).get(k)
+            if ttft is not None:
+                out[f"shared_prefix_{leg}_ttft_{k}_ms"] = ms(ttft)
+        out[f"shared_prefix_{leg}_stalled"] = bool(hit_stall)
+        if cache_on:
+            d_saved_cf = (obs["prefill_tokens_saved_total"]
+                          - warm_obs["prefill_tokens_saved_total"])
+            d_saved = pc["tokens_saved_total"] - warm_pc["tokens_saved_total"]
+            d_hits = pc["hit_blocks_total"] - warm_pc["hit_blocks_total"]
+            d_dup = (obs["duplicate_blocks_total"]
+                     - warm_obs["duplicate_blocks_total"])
+            out.update({
+                "shared_prefix_realized_hit_rate": round(pc["realized_hit_rate"], 4),
+                "shared_prefix_prefill_tokens_saved": d_saved,
+                "shared_prefix_counterfactual_tokens_saved": d_saved_cf,
+                # 1.0 = the tree realized exactly what the observatory
+                # predicted for this scenario
+                "shared_prefix_realized_vs_counterfactual":
+                    round(d_saved / max(d_saved_cf, 1), 4),
+                "shared_prefix_hit_blocks": d_hits,
+                "shared_prefix_duplicate_blocks": d_dup,
+                "shared_prefix_cow_copies": pc["cow_copies_total"]
+                    - warm_pc["cow_copies_total"],
+                "shared_prefix_peak_fragmentation_tokens":
+                    eng.health()["kv"]["census"]["peak_fragmentation_tokens"],
+            })
+    # byte-identity of the generated streams, cache on vs off (greedy): the
+    # arrival scenario flushes tokens as it goes, so the A/B runs the same
+    # batch through generate() on both warmed engines
+    out_on = legs[True].generate(prompts, max_new_tokens=max_new)
+    out_off = legs[False].generate(prompts, max_new_tokens=max_new)
+    out["shared_prefix_outputs_identical"] = out_on == out_off
+    off_p50 = out.get("shared_prefix_cache_off_ttft_p50_ms")
+    on_p50 = out.get("shared_prefix_cache_on_ttft_p50_ms")
+    if off_p50 and on_p50 is not None:
+        out["shared_prefix_ttft_p50_delta_pct"] = round(
+            (off_p50 - on_p50) / off_p50 * 100.0, 1)
+    return out
 
 
 def _ops_refresh_cost(eng, rounds: int = 20):

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
                               ServingFastpathConfig,
                               ServingFaultToleranceConfig,
+                              ServingPrefixCacheConfig,
                               ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
@@ -63,6 +64,11 @@ class InferenceConfig(ConfigModel):
     # + capacity forecast — inference/v2/kv_metrics.py (section defined in
     # runtime/config.py so train+serve configs share one spelling)
     serving_kv_observability: KVObservabilityConfig = Field(KVObservabilityConfig)
+    # copy-on-write prefix caching: shared-prefix requests map live computed
+    # prompt blocks read-only and skip the duplicate prefill —
+    # inference/v2/ragged_manager.py PrefixCache (section defined in
+    # runtime/config.py so train+serve configs share one spelling)
+    serving_prefix_cache: ServingPrefixCacheConfig = Field(ServingPrefixCacheConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

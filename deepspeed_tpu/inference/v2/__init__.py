@@ -8,7 +8,8 @@ from .fastpath import PENDING_TOKEN, DeferredTokens, DeviceBatchState, ServeCoun
 from .journal import JournalEntry, JournalState, RequestJournal, replay_journal
 from .kv_metrics import (BlockCensus, CapacityForecaster, CensusInvariantError,
                          KVObservability, PrefixObservatory, block_hashes)
-from .ragged_manager import (EmptyPromptError, RaggedStateManager, SequenceDescriptor,
+from .ragged_manager import (EmptyPromptError, PrefixCache, PrefixEntry,
+                             RaggedStateManager, SequenceDescriptor,
                              UnknownSequenceError)
 from .scheduler import ScheduledChunk, SplitFuseScheduler
 from .supervisor import (RecoveryPlan, ServeSpec, ServingSupervisor,

@@ -39,7 +39,7 @@ from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchS
                        ServeCounters, materialize, round_up_pow2)
 from .journal import RequestJournal, journal_bytes
 from .kv_metrics import KVObservability
-from .ragged_manager import RaggedStateManager
+from .ragged_manager import PrefixCache, RaggedStateManager
 from .scheduler import SplitFuseScheduler
 
 def candidate_sample(row, rng, *, temperature, top_k, top_p, axis):
@@ -95,6 +95,19 @@ class InferenceEngineV2:
         self.dtype = _DTYPES[self.config.dtype]
         self.block_size = block_size
         self.manager = RaggedStateManager(num_blocks, block_size, max_blocks_per_seq)
+        # copy-on-write prefix caching (ISSUE 13): requests whose leading full
+        # prompt blocks match live computed blocks map them read-only
+        # (allocator refcount) and prefill only their divergent tail — the
+        # realized form of the counterfactual PR 12's PrefixObservatory
+        # measures, keyed on the same chained token-block hashes.  The engine
+        # contributes the ONE device action: the CoW block copy for prompts
+        # cached to their last token.
+        self.prefix_cfg = self.config.serving_prefix_cache
+        if self.prefix_cfg.enabled:
+            self.manager.prefix_cache = PrefixCache(
+                block_size, cow=self.prefix_cfg.cow,
+                defer_shared_prefill=self.prefix_cfg.defer_shared_prefill)
+            self.manager.cow_copy = self._cow_copy_block
         # block-level KV-pool observability (ISSUE 12): census + prefix-
         # sharing opportunity + capacity forecast, all from host state the
         # manager/allocator already own — zero device syncs (the kv-obs smoke
@@ -289,8 +302,9 @@ class InferenceEngineV2:
         deadline = now + ttl if ttl is not None else None
         self._reset_table_width_if_idle()
         for uid, prompt in zip(uids, prompts):
-            self.manager.add_sequence(int(uid), [int(t) for t in prompt],
-                                      deadline=deadline)
+            seq = self.manager.add_sequence(int(uid), [int(t) for t in prompt],
+                                            deadline=deadline)
+            self._map_prefix(seq)
             if self.journal is not None:
                 # step()-level requests journal too (max_new_tokens=0: the
                 # caller's own loop owns the budget) so a crash loses neither
@@ -373,6 +387,26 @@ class InferenceEngineV2:
             ints((n, t)), ints((n, )), ints((n, )), ints((n, b))).compile()
         self._fwd_cache[key] = compiled
         self.counters.compiles += 1
+
+    def _cow_copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write block duplication (ISSUE 13): copy one KV block's
+        contents device-side so a fully-prefix-cached prompt's single
+        recomputed position writes a PRIVATE block, never a shared one.  One
+        compiled program serves every copy (src/dst ride as a traced [2]
+        array); every paged cache in the model zoo lays blocks on axis 1
+        ([L, num_blocks, ...] — models/transformer.py), which this relies on."""
+        fn = self._fwd_cache.get("cow_copy")
+        if fn is None:
+            def copy(kv, pair):
+                return jax.tree_util.tree_map(
+                    lambda leaf: leaf.at[:, pair[1]].set(leaf[:, pair[0]]), kv)
+            fn = jax.jit(copy, donate_argnums=(0, ))
+            self._fwd_cache["cow_copy"] = fn
+            self.counters.compiles += 1
+        self.counters.dispatches += 1
+        self.counters.uploads += 1
+        self.counters.upload_ints += 2
+        self.kv = fn(self.kv, jnp.asarray([src, dst], jnp.int32))
 
     # batch-shape bucketing shares the ONE pow2 primitive with the scatter-row
     # padding in fastpath.DeviceBatchState (divergence would multiply shapes)
@@ -498,6 +532,8 @@ class InferenceEngineV2:
         for i, c in enumerate(chunks):
             seq = self.manager.seqs[c.uid]
             seq.seen_tokens += c.n_tokens
+            # prompt blocks this chunk just completed become shareable
+            self.manager.register_prefix_blocks(seq)
             if seq.seen_tokens >= len(seq.tokens):
                 # produced a next token (end of prompt, or a decode step)
                 seq.tokens.append(PENDING_TOKEN)
@@ -557,6 +593,8 @@ class InferenceEngineV2:
         for i, c in enumerate(chunks):
             seq = self.manager.seqs[c.uid]
             seq.seen_tokens += c.n_tokens
+            # prompt blocks this chunk just completed become shareable
+            self.manager.register_prefix_blocks(seq)
             if seq.seen_tokens >= len(seq.tokens):
                 tok = int(toks[i])
                 seq.tokens.append(tok)
@@ -626,6 +664,16 @@ class InferenceEngineV2:
         prompts.update(extra_prompts)
         obs.observe(prompts)
 
+    def _map_prefix(self, seq) -> int:
+        """Admit-time shared-prefix mapping with the hit landed in the flight
+        recorder (the scheduler's per-chunk late-binding remap shares the
+        manager seam but skips the event — per-step noise)."""
+        mapped = self.manager.map_prefix(seq)
+        if mapped:
+            self.tracer.event("prefix_hit", uid=seq.uid, tokens=mapped,
+                              blocks=len(seq.blocks))
+        return mapped
+
     def _forget_prefix(self, uid: int) -> None:
         """Invalidate a uid's PrefixObservatory hash cache for a request that
         dies WITHOUT ever becoming a live sequence (queue expiry, stall
@@ -642,9 +690,11 @@ class InferenceEngineV2:
         while free, none leaked).  Raises ``CensusInvariantError`` naming the
         offending uid/block.  Run automatically after every serve pass
         (``serving_kv_observability.invariant_check``); public so smokes and
-        fault-injection tests can assert it at arbitrary points."""
+        fault-injection tests can assert it at arbitrary points.  With prefix
+        sharing the live sequences ride along, so the refcount-agreement and
+        shared-content (no-request-observes-another's-KV) checks run too."""
         if self.kv_obs is not None:
-            self.kv_obs.check_invariant(self.manager.allocator)
+            self.kv_obs.check_invariant(self.manager.allocator, self.manager.seqs)
 
     # ---------------------------------------------------------- ops endpoints
     def refresh_ops(self, force: bool = False) -> None:
@@ -708,6 +758,16 @@ class InferenceEngineV2:
                 "kv_alloc_rate": fc.alloc_rate,
                 "kv_free_rate": fc.free_rate,
                 **({} if ste is None else {"kv_steps_to_exhaustion": float(ste)}),
+            })
+        pc = self.manager.prefix_cache
+        if pc is not None:
+            # realized prefix-cache savings (ISSUE 13) next to the
+            # counterfactual the observatory reports — same spelling the
+            # metrics registry exports
+            gauges.update({
+                "kv_prefix_hits": float(pc.hit_blocks_total),
+                "kv_prefill_tokens_saved": float(pc.tokens_saved_total),
+                "kv_prefix_realized_hit_rate": pc.realized_hit_rate(),
             })
         # SLO percentile gauges (ISSUE 6): ttft/tbt/e2e/queue_wait p50/p95/p99
         # from the tracer's streaming histograms ({} while tracing is off)
@@ -922,6 +982,9 @@ class InferenceEngineV2:
             produced = [int(t) for t in col[:n_real]]
             seq.tokens.extend(produced)
             seq.seen_tokens += n_real
+            # a burst's first position can complete the FINAL prompt block
+            # (a budget split at prompt_len - 1, or the CoW copy's recompute)
+            self.manager.register_prefix_blocks(seq)
             self.counters.burst_tokens += n_real
             out[seq.uid] = produced
         self.tracer.event("burst", step=self.scheduler.steps, k=k, seqs=len(live))
@@ -1499,10 +1562,15 @@ class InferenceEngineV2:
             # prompt + already-emitted prefix (prefilled in one pass — the KV
             # rebuild), with prompt_len pinned so the prefix keeps counting
             # as generated output, not prompt
-            self.manager.add_sequence(ticket.uid, ticket.prompt + ticket.prefix,
-                                      priority=ticket.priority,
-                                      deadline=ticket.deadline, queue_wait_s=wait,
-                                      prompt_len=len(ticket.prompt))
+            seq = self.manager.add_sequence(ticket.uid, ticket.prompt + ticket.prefix,
+                                            priority=ticket.priority,
+                                            deadline=ticket.deadline, queue_wait_s=wait,
+                                            prompt_len=len(ticket.prompt))
+            # admit-time prefix lookup (ISSUE 13): map whatever shared prompt
+            # blocks are already computed — a journal-replayed request lands
+            # back on the shared blocks its previous life rode — and the
+            # scheduler re-checks per prefill chunk for late-arriving hits
+            self._map_prefix(seq)
             self.tracer.event("admit", step=self.scheduler.steps, uid=ticket.uid,
                               **({"recovered": True} if ticket.recovered else {}))
             self.tracer.on_admit(ticket.uid, now, queue_wait_s=wait,
@@ -1606,6 +1674,10 @@ class InferenceEngineV2:
             # rollups/forecast health() carries, for stall postmortems that
             # need to see WHICH blocks are pinned where
             "kv": self._kv_snapshot(with_table=True),
+            # realized prefix-sharing state (ISSUE 13)
+            "prefix_cache": (self.manager.prefix_cache.snapshot()
+                             if self.manager.prefix_cache is not None
+                             else {"enabled": False}),
             # recovery state (ISSUE 8): restart/recovery counters + journal
             # size, so a crash postmortem's snapshot shows the durability side
             "fault_tolerance": self._fault_tolerance_snapshot(),
@@ -1649,6 +1721,12 @@ class InferenceEngineV2:
             # (fragmentation, block-age, blocks-per-request), counterfactual
             # prefix-cache opportunity, and the steps-to-exhaustion forecast
             "kv": self._kv_snapshot(),
+            # realized copy-on-write prefix sharing (ISSUE 13): hits, tokens
+            # saved, CoW copies, realized hit-rate — read next to the
+            # counterfactual under kv.prefix
+            "prefix_cache": (self.manager.prefix_cache.snapshot()
+                             if self.manager.prefix_cache is not None
+                             else {"enabled": False}),
             "scheduler_steps": self.scheduler.steps,
             "completed_total": self.manager.completed_requests,
             "failed_total": self.manager.failed_requests,

@@ -48,26 +48,40 @@ class CensusInvariantError(RuntimeError):
     """The census's owned-block set stopped partitioning the allocator's free
     list — either a block is owned by a sequence AND on the free list (the
     aliasing bug class the PR-4 double-free guard exists for) or a block
-    vanished from both sides (a leak).  Carries the offending block id and,
-    when known, the owning uid."""
+    vanished from both sides (a leak) — or, with copy-on-write prefix sharing
+    (ISSUE 13), a shared block's bookkeeping went inconsistent: census owners
+    disagree with the allocator refcount, or two mappers' token ids for the
+    block differ (one request would observe another's KV).  Carries the
+    offending block id and, when known, the owning uid(s)."""
 
     def __init__(self, message: str, *, block: Optional[int] = None,
-                 uid: Optional[int] = None):
+                 uid: Optional[int] = None, uid2: Optional[int] = None):
         super().__init__(message)
         self.block = block
         self.uid = uid
+        self.uid2 = uid2
 
 
 @dataclasses.dataclass
 class BlockRecord:
-    """One allocated block's census entry (all host ints)."""
-    uid: int                  # owning sequence
+    """One allocated block's census entry (all host ints).  ``owners`` lists
+    every sequence mapping the block — one entry for a private block, more
+    under copy-on-write prefix sharing; the record lives until the last
+    mapping is released (mirroring the allocator refcount)."""
+    owners: List[int]         # mapping sequences (first = the allocating writer)
     allocated_step: int       # scheduler step at allocation
     last_touched_step: int    # scheduler step of the last resident-token change
     tokens_resident: int = 0  # KV positions actually written into this block
 
-    def as_dict(self) -> Dict[str, int]:
-        return {"uid": self.uid, "allocated_step": self.allocated_step,
+    @property
+    def uid(self) -> int:
+        """The allocating (writer) uid — the single-owner view pre-sharing
+        callers read."""
+        return self.owners[0]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"uid": self.uid, "owners": list(self.owners),
+                "allocated_step": self.allocated_step,
                 "last_touched_step": self.last_touched_step,
                 "tokens_resident": self.tokens_resident}
 
@@ -129,7 +143,7 @@ class BlockCensus:
         uid = int(uid)
         n = 0
         for b in blocks:
-            self.blocks[int(b)] = BlockRecord(uid=uid,
+            self.blocks[int(b)] = BlockRecord(owners=[uid],
                                               allocated_step=self.step,
                                               last_touched_step=self.step)
             n += 1
@@ -139,15 +153,40 @@ class BlockCensus:
         if held > self._peak_blocks.get(uid, 0):
             self._peak_blocks[uid] = held
 
+    def on_share(self, uid: int, block: int) -> None:
+        """A sequence mapped an existing block read-only (copy-on-write
+        prefix sharing, ISSUE 13): the block gains an owner — NOT an
+        allocation; the flow counters and the forecaster see only real
+        pool movement."""
+        uid = int(uid)
+        rec = self.blocks.get(int(block))
+        if rec is not None:
+            rec.owners.append(uid)
+        held = self._held_blocks.get(uid, 0) + 1
+        self._held_blocks[uid] = held
+        if held > self._peak_blocks.get(uid, 0):
+            self._peak_blocks[uid] = held
+
     def on_free(self, uid: int, blocks: Iterable[int]) -> None:
+        """Release ``uid``'s mapping of each block; the record (and the
+        freed-flow counter) goes only when the LAST owner lets go —
+        mirroring the allocator's refcount-zero release."""
         uid = int(uid)
         n = 0
+        fully = 0
         for b in blocks:
-            rec = self.blocks.pop(int(b), None)
-            if rec is not None:
-                n += 1
+            b = int(b)
+            rec = self.blocks.get(b)
+            if rec is None:
+                continue
+            n += 1
+            if uid in rec.owners:
+                rec.owners.remove(uid)
+            if not rec.owners:
+                del self.blocks[b]
                 self._resident_total -= rec.tokens_resident
-        self.blocks_freed_total += n
+                fully += 1
+        self.blocks_freed_total += fully
         if uid in self._held_blocks:
             self._held_blocks[uid] = max(self._held_blocks[uid] - n, 0)
 
@@ -188,6 +227,11 @@ class BlockCensus:
                 if rec is None:
                     continue  # the invariant check reports this, not refresh
                 resident = min(max(seen - i * bs, 0), bs)
+                if len(rec.owners) > 1:
+                    # a shared block is full by construction (only completed
+                    # prompt blocks are mappable); one owner's rollback must
+                    # not mark KV absent that the other owners still read
+                    resident = max(resident, rec.tokens_resident)
                 if resident != rec.tokens_resident:
                     self._resident_total += resident - rec.tokens_resident
                     rec.tokens_resident = resident
@@ -202,6 +246,12 @@ class BlockCensus:
     @property
     def allocated_blocks(self) -> int:
         return len(self.blocks)
+
+    def shared_blocks(self) -> int:
+        """Blocks currently mapped by more than one sequence (copy-on-write
+        prefix sharing) — the one home for this definition; the rollup and
+        the Prometheus gauge both read it."""
+        return sum(1 for rec in self.blocks.values() if len(rec.owners) > 1)
 
     def tokens_resident(self) -> int:
         return self._resident_total
@@ -236,6 +286,7 @@ class BlockCensus:
         return {
             "step": self.step,
             "allocated_blocks": self.allocated_blocks,
+            "shared_blocks": self.shared_blocks(),
             "free_blocks": int(free_blocks),
             "usable_blocks": usable,
             "utilization": self.allocated_blocks / usable,
@@ -256,11 +307,16 @@ class BlockCensus:
         return {b: rec.as_dict() for b, rec in sorted(self.blocks.items())}
 
     # ---------------------------------------------------------- invariant
-    def check_against(self, allocator) -> None:
+    def check_against(self, allocator, seqs: Optional[Dict[int, Any]] = None) -> None:
         """The census's owned set and the allocator's free list must exactly
-        partition the usable pool.  Raises :class:`CensusInvariantError`
-        naming the first offending uid/block; returns None when the invariant
-        holds."""
+        partition the usable pool; with copy-on-write sharing the refcount
+        invariant rides along — every census owner list must agree with the
+        allocator refcount, and (when ``seqs`` is provided) every mapper of a
+        shared block must hold IDENTICAL token ids for the block's positions,
+        or one request would be reading another's KV.  Raises
+        :class:`CensusInvariantError` naming the first offending uid/block
+        (and both uids for a shared-content violation); returns None when the
+        invariant holds."""
         free = allocator.free_block_set()
         owned = set(self.blocks)
         both = owned & free
@@ -288,6 +344,50 @@ class BlockCensus:
                 f"yet tracked"
                 + (f" by uid {uid}" if uid is not None else " as free"),
                 block=b, uid=uid)
+        # refcount agreement: owners-per-block must equal the allocator's
+        # outstanding mappings (a drifted count frees too early or leaks)
+        if hasattr(allocator, "refcount"):
+            for b, rec in self.blocks.items():
+                refs = allocator.refcount(b)
+                if refs != len(rec.owners):
+                    raise CensusInvariantError(
+                        f"block {b}: census lists {len(rec.owners)} owner(s) "
+                        f"{rec.owners} but the allocator refcount is {refs} — "
+                        f"a mapping was gained or released without the other "
+                        f"side noticing", block=b, uid=rec.owners[0])
+        if seqs is not None:
+            self._check_shared_content(seqs)
+
+    def _check_shared_content(self, seqs: Dict[int, Any]) -> None:
+        """Every mapper of a shared block must hold the SAME token ids for
+        the block's position range — the no-request-observes-another's-KV
+        invariant the prefix tree's token verification exists to uphold."""
+        bs = self.block_size
+        for b, rec in self.blocks.items():
+            if len(rec.owners) < 2:
+                continue
+            reference: Optional[List[int]] = None
+            ref_uid: Optional[int] = None
+            for uid in rec.owners:
+                seq = seqs.get(uid)
+                if seq is None:
+                    raise CensusInvariantError(
+                        f"block {b} is mapped by uid {uid} which the manager "
+                        f"no longer tracks — its mapping was never released",
+                        block=b, uid=uid)
+                if b not in seq.blocks:
+                    raise CensusInvariantError(
+                        f"block {b} lists uid {uid} as an owner but is absent "
+                        f"from that sequence's block table", block=b, uid=uid)
+                i = seq.blocks.index(b)
+                slice_ = [int(t) for t in seq.tokens[i * bs:(i + 1) * bs]]
+                if reference is None:
+                    reference, ref_uid = slice_, uid
+                elif slice_ != reference:
+                    raise CensusInvariantError(
+                        f"shared block {b} maps DIFFERENT content for uid "
+                        f"{ref_uid} and uid {uid} — one request is observing "
+                        f"another's KV", block=b, uid=ref_uid, uid2=uid)
 
 
 # ==========================================================================
@@ -567,9 +667,9 @@ class KVObservability:
             return ("entered", float(ste))
         return ("cleared", float("inf") if ste is None else float(ste))
 
-    def check_invariant(self, allocator) -> None:
+    def check_invariant(self, allocator, seqs: Optional[Dict[int, Any]] = None) -> None:
         self.invariant_checks_total += 1
-        self.census.check_against(allocator)
+        self.census.check_against(allocator, seqs)
 
     def snapshot(self, free_blocks: int) -> Dict[str, Any]:
         """The ``health()["kv"]`` payload (JSON-safe: no inf/nan)."""

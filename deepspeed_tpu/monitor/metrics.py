@@ -405,19 +405,48 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
     # (ISSUE 12 satellite): the scheduler's and decode_burst's kv-adjacent
     # gauges used to spell the pool three ways (serving_free_kv_blocks vs
     # serving_kv_utilization vs scheduler_kv_block_utilization).  Canonical
-    # names below; the old spellings stay as DEPRECATED aliases for one
-    # release so existing dashboards keep scraping.
+    # names only — the deprecated aliases (serving_free_kv_blocks,
+    # scheduler_kv_block_utilization) were kept one release and removed in
+    # ISSUE 13 (see README "KV-pool observability").
     ns_kv = f"{reg.namespace}_serving_kv"
     reg.set_gauge(f"{ns_kv}_free_blocks",
                   engine.manager.allocator.free_blocks,
                   help_text="free blocks in the paged KV pool")
-    reg.set_gauge(f"{reg.namespace}_serving_free_kv_blocks",
-                  engine.manager.allocator.free_blocks,
-                  help_text="DEPRECATED alias of serving_kv_free_blocks "
-                            "(removed next release)")
     reg.set_gauge(f"{ns_kv}_utilization",
                   engine.manager.kv_utilization(),
                   help_text="paged KV pool utilization [0, 1]")
+    # ---- realized copy-on-write prefix caching (ISSUE 13): the tree's
+    # lifetime counters next to the observatory's counterfactual families
+    # below — agreement between the two is the cache working as predicted
+    prefix_cache = getattr(engine.manager, "prefix_cache", None)
+    if prefix_cache is not None:
+        reg.set_counter(f"{ns_kv}_prefix_hits_total",
+                        prefix_cache.hit_blocks_total,
+                        help_text="prompt blocks served from the prefix tree "
+                                  "(read-only shared mappings + CoW copies)")
+        reg.set_counter(f"{ns_kv}_prefill_tokens_saved_total",
+                        prefix_cache.tokens_saved_total,
+                        help_text="prefill tokens skipped by mapping shared "
+                                  "prefix blocks (REALIZED; the counterfactual "
+                                  "twin is serving_kv_prefix_tokens_saved_total)")
+        reg.set_gauge(f"{ns_kv}_prefix_realized_hit_rate",
+                      prefix_cache.realized_hit_rate(),
+                      help_text="shared-or-copied blocks over all full prompt "
+                                "blocks (lifetime) — read next to the "
+                                "counterfactual serving_kv_prefix_hit_rate")
+        reg.set_counter(f"{ns_kv}_prefix_cow_copies_total",
+                        prefix_cache.cow_copies_total,
+                        help_text="copy-on-write block copies (prompts cached "
+                                  "to their last token)")
+        reg.set_counter(f"{ns_kv}_prefix_deferrals_total",
+                        prefix_cache.deferrals_total,
+                        help_text="prefill chunks deferred one step onto a "
+                                  "block another scheduled request was "
+                                  "computing")
+        reg.set_gauge(f"{ns_kv}_prefix_tree_entries",
+                      len(prefix_cache.entries),
+                      help_text="shareable fully-computed prompt blocks "
+                                "currently in the prefix tree")
     # block-level observability (ISSUE 12): census, counterfactual prefix-
     # cache opportunity, capacity forecast — all host ints the engine's
     # kv_obs already assembled (absent => kv observability disabled)
@@ -426,6 +455,9 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
         census, fc, prefix = kv_obs.census, kv_obs.forecaster, kv_obs.prefix
         reg.set_gauge(f"{ns_kv}_allocated_blocks", census.allocated_blocks,
                       help_text="census-owned blocks in the paged KV pool")
+        reg.set_gauge(f"{ns_kv}_shared_blocks", census.shared_blocks(),
+                      help_text="blocks currently mapped by more than one "
+                                "sequence (copy-on-write prefix sharing)")
         reg.set_gauge(f"{ns_kv}_fragmentation_tokens",
                       census.fragmentation_tokens(),
                       help_text="allocated-but-unfilled token slots "
@@ -492,15 +524,12 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
         if key == "preempted_total":
             continue  # already exported as a counter above
         if key == "kv_block_utilization":
-            # canonical spelling joins the serving_kv_* namespace; the old
-            # scheduler_-prefixed name stays one release as an alias
+            # canonical spelling under the serving_kv_* namespace (the
+            # scheduler_-prefixed alias served its one deprecation release
+            # and was removed in ISSUE 13)
             reg.set_gauge(f"{ns_kv}_block_utilization", value,
                           help_text="paged KV pool utilization at the last "
                                     "scheduled step")
-            reg.set_gauge(f"{reg.namespace}_scheduler_{key}", value,
-                          help_text="DEPRECATED alias of "
-                                    "serving_kv_block_utilization "
-                                    "(removed next release)")
             continue
         reg.set_gauge(f"{reg.namespace}_scheduler_{key}", value,
                       help_text="SplitFuse scheduler per-step gauge")

@@ -631,6 +631,36 @@ class KVObservabilityConfig(ConfigModel):
     age_buckets_per_decade: int = Field(6, ge=1, le=100)
 
 
+class ServingPrefixCacheConfig(ConfigModel):
+    """Copy-on-write prefix caching over the paged KV pool for the v2 ragged
+    engine (inference/v2/ragged_manager.py ``PrefixCache`` — the realized
+    form of vLLM-style block-granular prefix reuse / SGLang RadixAttention,
+    keyed on the same chained token-block hashes PR 12's
+    ``PrefixObservatory`` measures the counterfactual with).
+
+    ``enabled`` arms the tree: an admitted request whose leading FULL prompt
+    blocks match live, fully-computed blocks maps them read-only (allocator
+    refcount +1 per mapping; shared KV capacity counted once) and only
+    prefills its divergent tail — cutting TTFT and prefill FLOPs by exactly
+    the hit-rate the observatory predicts, at zero device cost when nothing
+    shares (the fastpath ServeCounters are byte-identical on a no-sharing
+    workload).
+
+    ``cow`` allows the copy-on-write block copy for prompts cached to their
+    LAST token: the final block's KV is duplicated into a private block so
+    the one recomputed position (needed for first-token logits) never writes
+    a shared block.  Off, such prompts simply recompute their final block.
+
+    ``defer_shared_prefill`` lets the scheduler hold a prefill chunk for ONE
+    step when a sequence already scheduled this step is computing the exact
+    block it needs — same-wave duplicates of one header become a one-step
+    delay plus a cache hit instead of duplicate prefill.
+    """
+    enabled: bool = True
+    cow: bool = True
+    defer_shared_prefill: bool = True
+
+
 class OpsServerConfig(ConfigModel):
     """Pull-based ops endpoints (monitor/metrics.py + monitor/ops_server.py —
     the PULL counterpart of the reference's push-only ``monitor/`` backends:
@@ -786,6 +816,9 @@ class TrainingConfig(ConfigModel):
     # block-level KV-pool observability (census + prefix-sharing opportunity
     # + capacity forecast) — same dual-spelling contract as above
     serving_kv_observability: KVObservabilityConfig = Field(KVObservabilityConfig)
+    # copy-on-write prefix caching over the paged KV pool — same
+    # dual-spelling contract as above
+    serving_prefix_cache: ServingPrefixCacheConfig = Field(ServingPrefixCacheConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -489,14 +489,15 @@ def kv_obs_smoke():
     value = lambda name: fams[name]["samples"][0][2]
     assert value("dstpu_serving_kv_prefix_tokens_saved_total") == \
         pfx["prefill_tokens_saved_total"]
-    assert value("dstpu_serving_kv_free_blocks") == value("dstpu_serving_free_kv_blocks")
-    for name in ("dstpu_serving_kv_utilization", "dstpu_serving_kv_fragmentation_tokens",
+    # the deprecated aliases (serving_free_kv_blocks /
+    # scheduler_kv_block_utilization) served their one release and are gone
+    assert "dstpu_serving_free_kv_blocks" not in fams
+    assert "dstpu_scheduler_kv_block_utilization" not in fams
+    for name in ("dstpu_serving_kv_free_blocks", "dstpu_serving_kv_utilization",
+                 "dstpu_serving_kv_fragmentation_tokens",
                  "dstpu_serving_kv_under_pressure",
                  "dstpu_serving_kv_block_utilization"):
         assert name in fams, f"missing /metrics family {name}"
-    # absent while idle by design: an inf gauge would poison the JSON
-    # exchange files (it appears finite while trending toward exhaustion)
-    assert "dstpu_serving_kv_steps_to_exhaustion" not in fams
     for name in ("dstpu_serving_kv_block_age_steps",
                  "dstpu_serving_kv_blocks_per_request"):
         assert fams[name]["type"] == "histogram", name
@@ -540,6 +541,93 @@ def kv_obs_smoke():
                       "invariant_checks":
                           faulty.health()["kv"]["invariant_checks_total"],
                       "host_syncs": c_on["host_syncs"]}))
+    return 0
+
+
+def prefix_cache_smoke():
+    """CI smoke for copy-on-write prefix caching (ISSUE 13 acceptance): a
+    shared-prefix arrival run must (a) realize a prefix hit-rate > 0 with
+    prefill tokens saved EQUAL to the PrefixObservatory's counterfactual
+    prediction, (b) serve generated tokens byte-identical cache on vs off,
+    (c) fully reclaim the pool AND drain the tree at the end (weak entries:
+    sharing never pins capacity), with the refcount/census invariants clean
+    — including under 25% injected allocator faults — and (d) cost nothing
+    when there is nothing to share (fastpath ``ServeCounters`` byte-identical
+    cache on vs off on a no-sharing workload)."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from tests.unit.fault_injection_serving import FaultyBlockedAllocator
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=64, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    header = rng.integers(1, 128, 24).tolist()  # 3 full shared blocks
+    prompts = [header + rng.integers(1, 128, 4).tolist() for _ in range(6)]
+
+    def engine(enabled, **over):
+        merged = dict(kw)
+        merged.update(over)
+        return InferenceEngineV2(
+            llama, cfg, params,
+            config={"dtype": "float32",
+                    "serving_prefix_cache": {"enabled": enabled}}, **merged)
+
+    # ---- (a) realized savings == the observatory's counterfactual
+    on = engine(True)
+    out_on = on.generate(prompts, max_new_tokens=8)
+    pc = on.health()["prefix_cache"]
+    obs = on.health()["kv"]["prefix"]
+    assert pc["realized_hit_rate"] > 0.0, pc
+    assert pc["tokens_saved_total"] == obs["prefill_tokens_saved_total"], (pc, obs)
+    assert pc["hit_blocks_total"] == obs["duplicate_blocks_total"], (pc, obs)
+    # ---- (c) pool AND tree fully reclaimed at drain; invariants clean
+    on.check_kv_invariant()
+    assert on.manager.allocator.free_blocks == kw["num_blocks"] - 1
+    assert pc["entries"] == 0, pc
+
+    # ---- (b) byte-identical outputs cache on vs off
+    off = engine(False)
+    out_off = off.generate(prompts, max_new_tokens=8)
+    assert out_on == out_off, "prefix caching changed the served tokens"
+
+    # ---- invariants under 25% injected allocator faults + preemption pressure
+    faulty = engine(True, num_blocks=40, token_budget=32, max_seqs_per_step=4)
+    faulty.manager.allocator = FaultyBlockedAllocator(40, fail_rate=0.25, seed=11)
+    results = faulty.generate(prompts, max_new_tokens=6, strict=False)
+    assert all(r.status == "ok" for r in results), [r.status for r in results]
+    assert faulty.manager.allocator.injected_failures > 0, "faults never fired"
+    faulty.check_kv_invariant()
+    assert faulty.manager.allocator.free_blocks == 39
+    assert faulty.health()["prefix_cache"]["hits_total"] > 0
+
+    # ---- (d) zero cost with nothing to share: counters byte-identical
+    distinct = [rng.integers(1, 128, int(n)).tolist()
+                for n in rng.integers(3, 30, 6)]
+    snaps = {}
+    for enabled in (True, False):
+        e = engine(enabled)
+        o = e.generate(distinct, max_new_tokens=6)
+        snaps[enabled] = (e.counters.snapshot(), o)
+    assert snaps[True] == snaps[False], \
+        "an idle prefix cache disturbed the host-link counters"
+
+    print(json.dumps({"prefix_cache_smoke": "ok", "requests": len(prompts),
+                      "realized_hit_rate": round(pc["realized_hit_rate"], 4),
+                      "prefill_tokens_saved": pc["tokens_saved_total"],
+                      "counterfactual_tokens": obs["prefill_tokens_saved_total"],
+                      "deferrals": pc["deferrals_total"],
+                      "byte_identical": out_on == out_off,
+                      "injected_failures":
+                          faulty.manager.allocator.injected_failures}))
     return 0
 
 
@@ -1006,6 +1094,7 @@ def main():
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
              run_smoke_lane("ops_smoke", "--ops-smoke"),
              run_smoke_lane("kv_obs_smoke", "--kv-obs-smoke"),
+             run_smoke_lane("prefix_cache_smoke", "--prefix-cache-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_drift_families_lane(),
@@ -1032,6 +1121,8 @@ if __name__ == "__main__":
         sys.exit(ops_smoke())
     if "--kv-obs-smoke" in sys.argv:
         sys.exit(kv_obs_smoke())
+    if "--prefix-cache-smoke" in sys.argv:
+        sys.exit(prefix_cache_smoke())
     if "--serving-recovery-smoke" in sys.argv:
         sys.exit(serving_recovery_smoke())
     if "--elastic-smoke" in sys.argv:

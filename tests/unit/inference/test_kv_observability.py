@@ -426,8 +426,9 @@ def test_kv_sections_are_json_safe_and_mirrored():
     held = {b for s in eng.manager.seqs.values() for b in s.blocks}
     assert set(snap_kv["census_table"]) == held
     for rec in snap_kv["census_table"].values():
-        assert set(rec) == {"uid", "allocated_step", "last_touched_step",
-                            "tokens_resident"}
+        assert set(rec) == {"uid", "owners", "allocated_step",
+                            "last_touched_step", "tokens_resident"}
+        assert rec["uid"] == rec["owners"][0]
     eng.flush(77)
 
 
@@ -435,24 +436,34 @@ def test_registry_exports_unified_serving_kv_families():
     from deepspeed_tpu.monitor.exposition import parse_exposition, render
     from deepspeed_tpu.monitor.metrics import MetricsRegistry, populate_from_engine
     eng = tiny_engine()
+    # steps_to_exhaustion is ABSENT while the pool is idle (an inf gauge
+    # would poison the per-rank JSON exchange files): a never-served engine
+    # is the canonical idle state (a short prefix-cached serve can end with
+    # a few EWMA updates still carrying a positive net rate)
+    reg0 = MetricsRegistry()
+    populate_from_engine(reg0, eng)
+    assert "dstpu_serving_kv_steps_to_exhaustion" not in \
+        parse_exposition(render(reg0, collect=False))
     header = list(range(1, 25))
     eng.generate([header + [i] for i in range(3)], max_new_tokens=4)
     reg = MetricsRegistry()
     populate_from_engine(reg, eng)
     fams = parse_exposition(render(reg, collect=False))
     value = lambda n: fams[n]["samples"][0][2]
-    # canonical spelling and the one-release deprecated aliases agree
-    assert value("dstpu_serving_kv_free_blocks") == value("dstpu_serving_free_kv_blocks")
-    assert value("dstpu_serving_kv_block_utilization") == \
-        value("dstpu_scheduler_kv_block_utilization")
-    assert "DEPRECATED" in fams["dstpu_serving_free_kv_blocks"]["help"]
-    assert "DEPRECATED" in fams["dstpu_scheduler_kv_block_utilization"]["help"]
+    # canonical spelling ONLY: the deprecated aliases served their one
+    # release (ISSUE 12) and are gone (ISSUE 13)
+    assert "dstpu_serving_kv_free_blocks" in fams
+    assert "dstpu_serving_kv_block_utilization" in fams
+    assert "dstpu_serving_free_kv_blocks" not in fams
+    assert "dstpu_scheduler_kv_block_utilization" not in fams
     assert value("dstpu_serving_kv_prefix_tokens_saved_total") > 0
+    # realized prefix-cache families live next to the counterfactual ones
+    assert value("dstpu_serving_kv_prefix_hits_total") > 0
+    assert value("dstpu_serving_kv_prefill_tokens_saved_total") > 0
+    assert 0.0 < value("dstpu_serving_kv_prefix_realized_hit_rate") <= 1.0
     assert fams["dstpu_serving_kv_blocks_per_request"]["type"] == "histogram"
-    # steps_to_exhaustion is ABSENT while the pool is idle (an inf gauge
-    # would poison the per-rank JSON exchange files) and appears finite the
-    # moment the forecaster trends toward exhaustion
-    assert "dstpu_serving_kv_steps_to_exhaustion" not in fams
+    # ... and appears finite the moment the forecaster trends toward
+    # exhaustion
     fc = eng.kv_obs.forecaster
     fc.alloc_rate, fc.free_rate, fc.free_blocks = 5.0, 1.0, 40
     reg2 = MetricsRegistry()

@@ -1,0 +1,431 @@
+"""Copy-on-write prefix caching suite (ISSUE 13): tree insert/lookup/partial
+hits, hash-collision safety (token ids verified, never trusted from a hash),
+CoW on the fully-cached-prompt write, and the refcount lifecycle across
+finish / evict / preempt / TTL expiry / journal-replay recovery — plus the
+engine-level acceptance: byte-identical outputs cache on vs off (strict and
+non-strict, fastpath and reference loops), realized savings equal to the
+PrefixObservatory's counterfactual, and byte-identical fastpath
+``ServeCounters`` on a workload with nothing to share.  CPU backend, greedy
+decode (token-count-exact)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (BlockCensus, CensusInvariantError,
+                                        InferenceEngineV2, PrefixCache,
+                                        RaggedStateManager, RecoveredRequest,
+                                        block_hashes)
+from deepspeed_tpu.models import llama
+from tests.unit.fault_injection_serving import FakeClock, FaultyBlockedAllocator
+
+BS = 8  # block size every manager/engine in this file uses
+
+
+def make_manager(num_blocks=32, max_blocks=8, census=True, cow_copy=None):
+    m = RaggedStateManager(num_blocks, BS, max_blocks,
+                           prefix_cache=PrefixCache(BS))
+    if census:
+        m.census = BlockCensus(BS, num_blocks, m.trash_block)
+    m.cow_copy = cow_copy
+    return m
+
+
+def prefill(m, seq, upto=None):
+    """Simulate completed prefill: grow blocks, advance seen_tokens, offer
+    the completed prompt blocks to the tree — the engine's step-path seam."""
+    upto = len(seq.tokens) if upto is None else upto
+    m.ensure_blocks(seq, upto)
+    seq.seen_tokens = upto
+    m.register_prefix_blocks(seq)
+
+
+_ENGINE_CACHE = {}
+
+
+def tiny_engine(config=None, **overrides):
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    if "params" not in _ENGINE_CACHE:
+        _ENGINE_CACHE["params"] = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=BS, max_blocks_per_seq=8,
+              token_budget=64, max_seqs_per_step=8)
+    kw.update(overrides)
+    return InferenceEngineV2(llama, cfg, _ENGINE_CACHE["params"],
+                             config={"dtype": "float32", **(config or {})}, **kw)
+
+
+HEADER = list(range(100, 124))  # 3 full shared blocks
+
+
+# ------------------------------------------------------------- tree mechanics
+def test_tree_partial_hit_maps_only_matching_prefix():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1, 2, 3, 4])
+    prefill(m, a)
+    assert len(m.prefix_cache) == 3
+    # b shares blocks 0-1, diverges inside block 2
+    b_prompt = HEADER[:16] + [77] * 8 + [5, 6]
+    b = m.add_sequence(1, b_prompt)
+    saved = m.map_prefix(b)
+    assert saved == 16 and b.seen_tokens == 16
+    assert b.blocks == a.blocks[:2]
+    assert m.prefix_cache.hits_total == 2
+    # the divergent tail allocates private blocks
+    prefill(m, b)
+    assert b.blocks[2] not in a.blocks
+    m.census.check_against(m.allocator, m.seqs)
+
+
+def test_map_prefix_stops_once_private_progress_exists():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    b = m.add_sequence(1, HEADER + [2])
+    b.seen_tokens = 3  # mid-block private progress (a rolled-back resume)
+    m.ensure_blocks(b, 3)
+    assert m.map_prefix(b) == 0  # never maps over private KV
+
+
+def test_lookup_verifies_token_ids_not_just_hashes():
+    """Hash-collision safety: an entry whose hash matches but whose actual
+    token ids (or ancestry) differ must be rejected, not served."""
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    cache = m.prefix_cache
+    b = m.add_sequence(1, HEADER + [2])
+    # poison the tree: same hash key, different recorded tokens — the
+    # manufactured equivalent of a blake2b collision
+    cache.entries[b.prefix_hashes[0]].tokens = tuple([999] * BS)
+    assert m.map_prefix(b) == 0
+    assert cache.collision_rejects_total == 1
+    # ancestry is verified too
+    cache.entries[b.prefix_hashes[0]].tokens = tuple(HEADER[:BS])
+    cache.entries[b.prefix_hashes[0]].parent = b"bogus"
+    assert m.map_prefix(b) == 0
+    assert cache.collision_rejects_total == 2
+
+
+def test_register_is_first_writer_wins():
+    cache = PrefixCache(BS)
+    assert cache.register(b"h", b"", 4, tuple(range(BS)))
+    assert not cache.register(b"h", b"", 9, tuple(range(BS)))
+    assert cache.entries[b"h"].block == 4
+    assert cache.registered_total == 1
+
+
+# -------------------------------------------------------- refcount lifecycle
+def test_shared_block_freed_only_by_last_owner():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    b = m.add_sequence(1, HEADER + [2])
+    assert m.map_prefix(b) == 24
+    shared = list(b.blocks)
+    assert all(m.allocator.refcount(blk) == 2 for blk in shared)
+    m.retire(0)  # a finishes first: b still maps every shared block
+    assert all(m.allocator.refcount(blk) == 1 for blk in shared)
+    assert all(blk not in m.allocator.free_block_set() for blk in shared)
+    assert len(m.prefix_cache) == 3  # entries outlive the registrant
+    m.census.check_against(m.allocator, m.seqs)
+    m.retire(1)
+    assert m.allocator.free_blocks == 31  # pool fully reclaimed
+    assert len(m.prefix_cache) == 0      # weak entries die with the blocks
+    assert m.prefix_cache.evicted_total == 3
+
+
+def test_evict_and_fail_decrement_not_free():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    b = m.add_sequence(1, HEADER + [2])
+    m.map_prefix(b)
+    m.evict(b, "deadline_expired")  # TTL expiry mid-life
+    assert all(m.allocator.refcount(blk) == 1 for blk in a.blocks[:3])
+    m.census.check_against(m.allocator, m.seqs)
+    c = m.add_sequence(2, HEADER + [3])
+    m.map_prefix(c)
+    m.fail(2, "injected")           # failure path decrements too
+    assert all(m.allocator.refcount(blk) == 1 for blk in a.blocks[:3])
+    m.census.check_against(m.allocator, m.seqs)
+
+
+def test_preempted_sharer_releases_and_remaps():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    b = m.add_sequence(1, HEADER + list(range(50, 60)))
+    m.map_prefix(b)
+    prefill(m, b)  # 34 tokens -> 5 blocks (3 shared + 2 private)
+    assert len(b.blocks) == 5
+    # rollback INTO the shared region: 3 blocks dropped, but only the 2
+    # PRIVATE ones actually return to the pool (the shared mapping just
+    # decrements — preempt reports RELEASED capacity, which the scheduler's
+    # rescue policy keys on)
+    assert m.releasable_blocks(b, 2) == 2
+    freed = m.preempt(b, keep_blocks=2)
+    assert freed == 2 and b.seen_tokens == 16
+    assert all(m.allocator.refcount(blk) == 2 for blk in b.blocks)  # kept shares
+    assert m.allocator.refcount(a.blocks[2]) == 1  # dropped mapping released
+    m.census.check_against(m.allocator, m.seqs)
+    # on resume the tree instantly re-serves the dropped shared block
+    assert m.map_prefix(b) == BS
+    assert b.blocks == a.blocks[:3]
+    m.retire(0)
+    m.retire(1)
+    assert m.allocator.free_blocks == 31
+
+
+def test_allocator_guards_still_bite():
+    m = make_manager(census=False)
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    with pytest.raises(ValueError, match="double free"):
+        m.allocator.free([a.blocks[0], a.blocks[0]])
+    m.allocator.free([a.blocks[0]])
+    with pytest.raises(ValueError, match="double free"):
+        m.allocator.free([a.blocks[0]])
+    with pytest.raises(ValueError, match="incref"):
+        m.allocator.incref(a.blocks[0])
+
+
+# --------------------------------------------------------------- CoW semantics
+def test_cow_on_fully_cached_prompt():
+    """A prompt cached to its last token must NOT write the shared block:
+    the final block is copied (cow_copy) and the one recomputed position
+    lands in the private copy."""
+    copies = []
+    m = make_manager(cow_copy=lambda src, dst: copies.append((src, dst)))
+    full = list(range(200, 232))  # 4 full blocks, prompt ends on a boundary
+    a = m.add_sequence(0, list(full))
+    prefill(m, a)
+    b = m.add_sequence(1, list(full))
+    saved = m.map_prefix(b)
+    assert saved == 24 + (BS - 1)
+    assert b.seen_tokens == 31 and b.pending_tokens == 1
+    assert b.blocks[:3] == a.blocks[:3]
+    assert b.blocks[3] != a.blocks[3]          # the private copy
+    assert copies == [(a.blocks[3], b.blocks[3])]
+    assert m.allocator.refcount(b.blocks[3]) == 1
+    assert m.prefix_cache.cow_copies_total == 1
+    m.census.check_against(m.allocator, m.seqs)
+
+
+def test_cow_declines_without_copy_seam():
+    """No copy seam (cow disabled / bare manager): the final block is simply
+    recomputed — shared mapping stops one block short, nothing pends at 0."""
+    m = make_manager(cow_copy=None)
+    full = list(range(200, 232))
+    a = m.add_sequence(0, list(full))
+    prefill(m, a)
+    b = m.add_sequence(1, list(full))
+    assert m.map_prefix(b) == 24
+    assert b.seen_tokens == 24 and b.pending_tokens == 8
+    assert len(b.blocks) == 3
+
+
+def test_rescue_never_preempts_victims_that_release_nothing():
+    """A starved decode must not burn a shared-prefix victim's preemption
+    budget (or evict it) when dropping its blocks would only decrement
+    refcounts — the capacity lives with the other mapper, so the rescue
+    gains nothing and the victim pays everything."""
+    from deepspeed_tpu.runtime.config import ServingResilienceConfig
+    from deepspeed_tpu.inference.v2 import SplitFuseScheduler
+
+    # pool with exactly enough for: a's 4 blocks + decoder d's 3 blocks
+    m = make_manager(num_blocks=8, max_blocks=8)
+    a = m.add_sequence(0, HEADER + [1, 2, 3, 4, 5, 6, 7, 8])  # 4 full blocks
+    prefill(m, a)
+    # decoder d: 25 tokens, 24 prefilled into 3 blocks — its next decode
+    # token needs a 4th block the pool doesn't have
+    d = m.add_sequence(1, list(range(60, 85)))
+    prefill(m, d, upto=24)
+    assert d.pending_tokens == 1
+    assert m.allocator.free_blocks == 0
+    # victim b maps a's 3 header blocks read-only: NOTHING in its table is
+    # releasable, and its divergent tail is still unallocated
+    b = m.add_sequence(2, HEADER + [40] * 10)
+    assert m.map_prefix(b) == 24
+    assert m.releasable_blocks(b, 0) == 0
+    # d decodes: needs one more block; pool empty; the only prefilling
+    # candidate (b) releases nothing — the rescue must decline, not churn
+    sched = SplitFuseScheduler(32, 8, resilience=ServingResilienceConfig())
+    chunks = sched.schedule(m)
+    assert b.preemptions == 0 and not b.done  # no useless preemption/eviction
+    assert sched.preempted_total == 0
+    assert all(c.uid != 1 for c in chunks)  # the decode genuinely waits
+    m.census.check_against(m.allocator, m.seqs)
+
+
+# ------------------------------------------------------- census + invariants
+def test_invariant_names_block_and_both_uids_on_foreign_kv():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    b = m.add_sequence(1, HEADER + [2])
+    m.map_prefix(b)
+    m.census.check_against(m.allocator, m.seqs)  # clean while honest
+    # corrupt one mapper's token view of a shared block — the exact state
+    # "request b observes request a's KV" produces
+    b.tokens[3] = 999
+    with pytest.raises(CensusInvariantError) as exc:
+        m.census.check_against(m.allocator, m.seqs)
+    assert exc.value.block == a.blocks[0]
+    assert {exc.value.uid, exc.value.uid2} == {0, 1}
+    assert "observing another's KV" in str(exc.value)
+
+
+def test_invariant_catches_refcount_drift():
+    m = make_manager()
+    a = m.add_sequence(0, HEADER + [1])
+    prefill(m, a)
+    m.allocator.incref(a.blocks[1])  # mapping the census never heard about
+    with pytest.raises(CensusInvariantError) as exc:
+        m.census.check_against(m.allocator, m.seqs)
+    assert exc.value.block == a.blocks[1]
+    assert "refcount" in str(exc.value)
+
+
+# ---------------------------------------------------------- engine acceptance
+@pytest.mark.parametrize("fastpath", [True, False])
+@pytest.mark.parametrize("strict", [True, False])
+def test_outputs_byte_identical_cache_on_vs_off(fastpath, strict):
+    rng = np.random.default_rng(3)
+    prompts = [HEADER + rng.integers(1, 128, 5).tolist() for _ in range(4)]
+    outs = {}
+    for enabled in (True, False):
+        eng = tiny_engine(config={
+            "serving_prefix_cache": {"enabled": enabled},
+            "serving_fastpath": {"enabled": fastpath}})
+        outs[enabled] = eng.generate(prompts, max_new_tokens=6, strict=strict)
+        if enabled:
+            pc = eng.health()["prefix_cache"]
+            assert pc["hits_total"] > 0 and pc["tokens_saved_total"] > 0
+            eng.check_kv_invariant()
+            assert eng.manager.allocator.free_blocks == 63  # drained
+            assert pc["entries"] == eng.health()["prefix_cache"]["entries"] == 0
+    if strict:
+        assert outs[True] == outs[False]
+    else:
+        assert [r.tokens for r in outs[True]] == [r.tokens for r in outs[False]]
+        assert all(r.ok for r in outs[True])
+
+
+def test_realized_savings_match_observatory_counterfactual():
+    """The acceptance gate: the tree realizes exactly the win PR 12's
+    observatory predicted — same-wave arrivals included (the scheduler's
+    defer-on-pending turns same-step duplicates into next-step hits)."""
+    rng = np.random.default_rng(5)
+    prompts = [HEADER + rng.integers(1, 128, 4).tolist() for _ in range(6)]
+    eng = tiny_engine()
+    eng.generate(prompts, max_new_tokens=4)
+    pc = eng.health()["prefix_cache"]
+    obs = eng.health()["kv"]["prefix"]
+    assert pc["tokens_saved_total"] == obs["prefill_tokens_saved_total"] == 120
+    assert pc["hits_total"] == obs["duplicate_blocks_total"] == 15
+    assert pc["realized_hit_rate"] == pytest.approx(obs["last_pass"]["hit_rate"])
+    assert pc["deferrals_total"] > 0  # same-wave sharing rode the deferral
+
+
+def test_no_sharing_workload_costs_nothing():
+    """Acceptance: on a workload with nothing to share the cache must be
+    free — fastpath ServeCounters byte-identical cache on vs off (<=1 host
+    sync per iteration and zero warm recompiles ride along, since the OFF
+    engine is the already-proven PR-5 baseline)."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, 128, int(n)).tolist()
+               for n in rng.integers(3, 30, 6)]
+    snaps = {}
+    for enabled in (True, False):
+        eng = tiny_engine(config={"serving_prefix_cache": {"enabled": enabled}})
+        out = eng.generate(prompts, max_new_tokens=6)
+        snaps[enabled] = (eng.counters.snapshot(), out)
+    assert snaps[True] == snaps[False]
+
+
+def test_shared_prefix_serve_under_allocator_faults():
+    """Fault-injection coverage: 25% probabilistic allocation failures
+    through a shared-prefix serve with preemption pressure — every request
+    still ok, the refcount+census invariants hold, the pool drains."""
+    rng = np.random.default_rng(11)
+    prompts = [HEADER + rng.integers(1, 128, int(n)).tolist()
+               for n in rng.integers(3, 16, 8)]
+    eng = tiny_engine(config={"serving_resilience": {"max_live_seqs": 4,
+                                                     "stall_watchdog_steps": 50}},
+                      num_blocks=40, token_budget=32, max_seqs_per_step=4)
+    eng.manager.allocator = FaultyBlockedAllocator(40, fail_rate=0.25, seed=11)
+    results = eng.generate(prompts, max_new_tokens=6, strict=False)
+    assert all(r.status == "ok" for r in results), [r.status for r in results]
+    assert eng.manager.allocator.injected_failures > 0
+    assert eng.health()["prefix_cache"]["hits_total"] > 0
+    eng.check_kv_invariant()
+    assert eng.manager.allocator.free_blocks == 39
+
+
+def test_mid_decode_ttl_expiry_of_a_sharer():
+    """A sharer evicted mid-decode (TTL expiry) releases its mappings while
+    the survivor keeps decoding on the same shared blocks, byte-identically
+    to an unshared serve."""
+    clock = FakeClock(tick=0.01)
+    eng = tiny_engine(clock=clock)
+    p_live = HEADER + [1, 2, 3]
+    p_doomed = HEADER + [4, 5, 6]
+    results = {r.uid: r for r in eng.generate(
+        [p_live, p_doomed], max_new_tokens=24, strict=False,
+        ttl_s=None, priorities=None)}
+    # both fine without deadlines; now re-serve with the second one doomed
+    eng2 = tiny_engine(clock=FakeClock(tick=0.05))
+    out = eng2.generate([p_live, p_doomed], max_new_tokens=24, strict=False,
+                        ttl_s=2.0)
+    by_uid = {r.uid: r for r in out}
+    eng2.check_kv_invariant()
+    assert eng2.manager.allocator.free_blocks == 63
+    # any request that did complete matches the deadline-free serve exactly
+    for uid, r in by_uid.items():
+        if r.ok:
+            assert r.tokens == results[uid].tokens
+    assert eng2.health()["prefix_cache"]["hits_total"] > 0
+
+
+def test_journal_recovery_lands_on_shared_blocks():
+    """``serve_recovered``'s prompt+prefix one-pass prefill re-maps the
+    shared prompt blocks of a surviving sequence instead of re-prefilling
+    them — and the recovered stream is byte-identical to a cache-off
+    recovery."""
+    tails = {}
+    for enabled in (True, False):
+        eng = tiny_engine(config={"serving_prefix_cache": {"enabled": enabled}})
+        # a live request holding the header hot, mid-decode via put()/step()
+        eng.put([7], [HEADER + [9, 9]])
+        for _ in range(3):
+            eng.step()
+        # a crashed request rejoins: same header, divergent tail, 2 tokens
+        # already emitted in its previous life
+        rec = RecoveredRequest(uid=3, prompt=HEADER + [8, 8], prefix=[5, 6],
+                               pin_ttl=True, ttl_s=None)
+        res = eng.serve_recovered([rec], max_new_tokens=6)
+        assert res[3].ok
+        # the journaled prefix survives verbatim at the head of the output
+        gen = res[3].tokens[len(rec.prompt):]
+        assert gen[:2] == [5, 6] and len(gen) == 6
+        tails[enabled] = res[3].tokens
+        if enabled:
+            assert eng.health()["prefix_cache"]["hits_total"] >= 3
+        eng.flush(7)
+        eng.check_kv_invariant()
+        assert eng.manager.allocator.free_blocks == 63
+    assert tails[True] == tails[False]
+
+
+def test_second_serve_accrues_identical_savings():
+    """uid reuse across generate() calls: the tree drains with the pool, so
+    a repeated workload earns the same savings again (no stale sharing, no
+    lost sharing)."""
+    prompts = [HEADER + [50 + i] for i in range(3)]
+    eng = tiny_engine()
+    eng.generate(prompts, max_new_tokens=3)
+    first = eng.health()["prefix_cache"]["tokens_saved_total"]
+    assert first > 0
+    eng.generate(prompts, max_new_tokens=3)
+    assert eng.health()["prefix_cache"]["tokens_saved_total"] == 2 * first
