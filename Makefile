@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -27,6 +27,19 @@ lint-baseline:
 # committing (the jax-api-surface rule fails CI on any unpinned symbol)
 lint-api-surface:
 	$(PY) bin/dstpu-lint --update-api-surface
+
+# re-pin the package's declared mesh axis names into .dslint-mesh-manifest.json
+# after a DELIBERATE mesh change — review the diff before committing (the
+# unknown-mesh-axis rule fails CI on any unpinned/stale axis)
+lint-mesh-manifest:
+	$(PY) bin/dstpu-lint --update-mesh-manifest
+
+# fast pre-push lane: lint only .py files changed vs BASE (default HEAD =
+# uncommitted work; use BASE=origin/main before pushing a branch).  Subset
+# lints still build whole-package context, so findings match the full run.
+BASE ?= HEAD
+lint-changed:
+	$(PY) bin/dstpu-lint --changed $(BASE)
 
 # the previously-drifted kernel/onebit/TP/sequence families, gated HARD-GREEN
 # (ISSUE 10): these are the tests that protect every multichip ROADMAP item

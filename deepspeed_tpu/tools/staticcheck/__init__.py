@@ -14,9 +14,14 @@ grandfathering policy.
 
 from .baseline import DEFAULT_BASELINE_NAME, load_baseline, save_baseline
 from .findings import Finding
+from .mesh_model import (DEFAULT_MESH_MANIFEST_NAME, MeshModel,
+                         collect_mesh_axes, load_mesh_manifest,
+                         save_mesh_manifest)
 from .rules import META_RULES, RULES, build_rules
 from .runner import LintResult, lint_source, run_lint
 
-__all__ = ["DEFAULT_BASELINE_NAME", "Finding", "LintResult", "META_RULES",
-           "RULES", "build_rules", "lint_source", "load_baseline", "run_lint",
-           "save_baseline"]
+__all__ = ["DEFAULT_BASELINE_NAME", "DEFAULT_MESH_MANIFEST_NAME", "Finding",
+           "LintResult", "META_RULES", "MeshModel", "RULES", "build_rules",
+           "collect_mesh_axes", "lint_source", "load_baseline",
+           "load_mesh_manifest", "run_lint", "save_baseline",
+           "save_mesh_manifest"]

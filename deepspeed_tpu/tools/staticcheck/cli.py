@@ -1,9 +1,12 @@
 """``dstpu-lint`` command line.
 
     dstpu-lint [paths...]                # default: deepspeed_tpu/ + tests/
+    dstpu-lint --changed [BASE]          # only files changed vs a git base
     dstpu-lint --format json             # machine-readable
+    dstpu-lint --format sarif            # CI inline-annotation format
     dstpu-lint --update-baseline         # grandfather current findings
     dstpu-lint --update-api-surface      # re-pin the external jax surface
+    dstpu-lint --update-mesh-manifest    # re-pin the declared mesh axes
     dstpu-lint --list-rules
 
 Exit codes: 0 clean, 1 non-baselined findings, 2 usage/internal error.
@@ -17,9 +20,12 @@ from .api_surface import (DEFAULT_MANIFEST_NAME, collect_api_surface,
                           load_api_surface, save_api_surface)
 from .baseline import (DEFAULT_BASELINE_NAME, load_baseline, load_baseline_entries,
                        save_baseline)
-from .reporters import report_json, report_text
+from .mesh_model import (DEFAULT_MESH_MANIFEST_NAME, collect_mesh_axes,
+                         load_mesh_manifest, save_mesh_manifest)
+from .reporters import report_json, report_sarif, report_text
 from .rules import META_RULES, RULES, build_rules
-from .runner import iter_python_files, load_modules, run_lint
+from .runner import (LintResult, changed_python_files, iter_python_files,
+                     load_modules, run_lint)
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -32,7 +38,15 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--root", default=None,
                    help="repo root for relative paths + default baseline location "
                         "(default: cwd)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"), default="text")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="BASE",
+                   help="lint only .py files changed vs the git BASE (default "
+                        "HEAD: uncommitted work; use origin/main for a "
+                        "pre-push pass), scoped to the standard scan roots — "
+                        "subset lints still build whole-package context, so "
+                        "findings match the full run; mutually exclusive "
+                        "with explicit paths")
     p.add_argument("--baseline", default=None,
                    help=f"baseline JSON path (default: <root>/{DEFAULT_BASELINE_NAME})")
     p.add_argument("--no-baseline", action="store_true",
@@ -45,6 +59,13 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--update-api-surface", action="store_true",
                    help="re-pin the package's external jax surface into the "
                         "manifest and exit 0 (review the diff before committing)")
+    p.add_argument("--mesh-manifest", default=None,
+                   help="mesh-axis manifest path "
+                        f"(default: <root>/{DEFAULT_MESH_MANIFEST_NAME})")
+    p.add_argument("--update-mesh-manifest", action="store_true",
+                   help="re-pin the package's declared mesh axis names into "
+                        "the manifest and exit 0 (review the diff before "
+                        "committing)")
     p.add_argument("--disable", default="",
                    help="comma-separated rule names to skip")
     p.add_argument("--select", default="",
@@ -65,7 +86,37 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
-    if args.paths:
+    if args.changed is not None and args.paths:
+        print("dstpu-lint: --changed computes its own file set; explicit "
+              "paths cannot be combined with it", file=sys.stderr)
+        return 2
+    if args.changed is not None and (args.update_baseline or
+                                     args.update_api_surface or
+                                     args.update_mesh_manifest):
+        # an empty change set exits 0 before the update blocks run — the
+        # requested regeneration would silently no-op while reporting success
+        print("dstpu-lint: --changed cannot be combined with --update-* "
+              "(baseline/manifest regeneration always covers the full "
+              "package)", file=sys.stderr)
+        return 2
+    if args.changed is not None:
+        try:
+            paths = changed_python_files(root, args.changed)
+        except (ValueError, OSError) as exc:
+            print(f"dstpu-lint: --changed: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            if args.format == "text":
+                print(f"dstpu-lint: no python files changed vs {args.changed}")
+            else:
+                # a CI consumer piping --format json/sarif must get a valid
+                # (empty) document on no-change runs, not a prose line
+                empty = LintResult(findings=[], baselined=[], suppressed_count=0,
+                                   files_checked=0, rules_run=[], seconds=0.0)
+                print({"json": report_json,
+                       "sarif": report_sarif}[args.format](empty))
+            return 0
+    elif args.paths:
         paths = args.paths
     else:
         # tests/ rides along by default, scanned only by test-scoped rules
@@ -122,6 +173,34 @@ def main(argv=None) -> int:
               f"symbol(s) over {len(modules)} package files) -> {api_path}")
         return 0
 
+    mesh_path = args.mesh_manifest or os.path.join(root, DEFAULT_MESH_MANIFEST_NAME)
+    if args.update_mesh_manifest:
+        # same hardening as the other two manifests: the pinned axis set is
+        # ALWAYS the whole package's declarations — a rule-restricted or
+        # path-restricted run must not quietly re-pin from a partial view
+        if selected or disabled:
+            print("dstpu-lint: --update-mesh-manifest cannot be combined with "
+                  "--select/--disable (the manifest is rule-independent and "
+                  "always covers the full package)", file=sys.stderr)
+            return 2
+        pkg = os.path.join(root, "deepspeed_tpu")
+        if not os.path.isdir(pkg):
+            print(f"dstpu-lint: no package at {pkg} to pin", file=sys.stderr)
+            return 2
+        modules, errors = load_modules(iter_python_files([pkg]), root)
+        if errors:
+            print(f"dstpu-lint: refusing to update the mesh manifest with "
+                  f"{len(errors)} unparseable file(s) — the pinned axis set "
+                  f"would be incomplete: "
+                  + "; ".join(f"{e.path}:{e.line}" for e in errors[:5]),
+                  file=sys.stderr)
+            return 2
+        axes = collect_mesh_axes(modules)
+        save_mesh_manifest(mesh_path, axes)
+        print(f"dstpu-lint: mesh manifest updated ({len(axes)} axis name(s) "
+              f"over {len(modules)} package files) -> {mesh_path}")
+        return 0
+
     baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE_NAME)
     try:
         baseline = {} if (args.no_baseline or args.update_baseline) \
@@ -135,10 +214,16 @@ def main(argv=None) -> int:
         print(f"dstpu-lint: bad api-surface manifest {api_path}: {exc}",
               file=sys.stderr)
         return 2
+    try:
+        mesh_manifest = load_mesh_manifest(mesh_path)
+    except (ValueError, OSError) as exc:
+        print(f"dstpu-lint: bad mesh manifest {mesh_path}: {exc}",
+              file=sys.stderr)
+        return 2
 
     result = run_lint(paths, root=root, rules=rules, baseline=baseline,
                       report_unused_suppressions=not args.no_unused_suppressions,
-                      api_surface=api_surface)
+                      api_surface=api_surface, mesh_manifest=mesh_manifest)
 
     if args.update_baseline:
         # meta findings (stale suppressions, bad comments, parse errors) are
@@ -154,7 +239,9 @@ def main(argv=None) -> int:
               f"{len(preserved)} out-of-scope entr(ies) preserved) -> {baseline_path}")
         return 0
 
-    print(report_json(result) if args.format == "json" else report_text(result))
+    reporter = {"json": report_json, "sarif": report_sarif,
+                "text": report_text}[args.format]
+    print(reporter(result))
     return 0 if result.ok else 1
 
 

@@ -63,6 +63,16 @@ class ModuleInfo:
         return ""
 
 
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost name of a ``Name``/``Attribute`` expression (``jnp.zeros``
+    -> "zeros") — the shared call-identification helper of rules/mesh model."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
 def _is_jax_jit(func: ast.AST) -> bool:
     """Matches ``jax.jit`` / bare ``jit`` (imported from jax)."""
     if isinstance(func, ast.Attribute) and func.attr == "jit" and \
@@ -125,6 +135,40 @@ class DonationSite:
     binding: str  # "local" | "attribute" | "container" | "returned" | "immediate" | "other"
     name: Optional[str]  # local/attribute name when binding is local/attribute
     fn_node: Optional[ast.AST]  # resolved function def, when available
+
+
+@dataclasses.dataclass
+class StaticJitSite:
+    """A ``jax.jit(..., static_argnums/static_argnames=...)`` call and how
+    the resulting callable is bound — the recompile-risk rule audits every
+    call site's static-position arguments (each distinct value is a fresh
+    compiled program)."""
+    jit_call: ast.Call
+    static_positions: Tuple[int, ...]  # from static_argnums
+    static_names: Tuple[str, ...]  # from static_argnames
+    # DonationSite.binding vocabulary plus "decorated" (@jax.jit(...) /
+    # @partial(jax.jit, ...) on a def — `name` is the decorated function)
+    binding: str
+    name: Optional[str]
+    fn_node: Optional[ast.AST]
+
+
+def _binding_of(jit_call: ast.Call) -> Tuple[str, Optional[str]]:
+    """How the callable produced by ``jit_call`` is bound at the site."""
+    up = parent(jit_call)
+    if isinstance(up, ast.Call) and up.func is jit_call:
+        return "immediate", None
+    if isinstance(up, ast.Return):
+        return "returned", None
+    if isinstance(up, ast.Assign) and len(up.targets) == 1:
+        tgt = up.targets[0]
+        if isinstance(tgt, ast.Name):
+            return "local", tgt.id
+        if isinstance(tgt, ast.Attribute):
+            return "attribute", tgt.attr
+        if isinstance(tgt, ast.Subscript):
+            return "container", None
+    return "other", None
 
 
 class _FunctionCollector(ast.NodeVisitor):
@@ -243,22 +287,62 @@ def collect_donation_sites(module: ModuleInfo) -> List[DonationSite]:
         donated = tuple(sorted(donated))
         if not donated:
             continue
-        up = parent(node)
-        binding, name = "other", None
-        if isinstance(up, ast.Call) and up.func is node:
-            binding = "immediate"
-        elif isinstance(up, ast.Return):
-            binding = "returned"
-        elif isinstance(up, ast.Assign) and len(up.targets) == 1:
-            tgt = up.targets[0]
-            if isinstance(tgt, ast.Name):
-                binding, name = "local", tgt.id
-            elif isinstance(tgt, ast.Attribute):
-                binding, name = "attribute", tgt.attr
-            elif isinstance(tgt, ast.Subscript):
-                binding = "container"
+        binding, name = _binding_of(node)
         sites.append(DonationSite(jit_call=node, donated=donated, binding=binding,
                                   name=name, fn_node=fn_node))
+    return sites
+
+
+def _static_kwargs(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_tuple(kw.value)
+    return nums, names
+
+
+def collect_static_jit_sites(module: ModuleInfo) -> List[StaticJitSite]:
+    tree = module.tree
+    collector = _FunctionCollector()
+    collector.visit(tree)
+    # a @jax.jit(...) decorator is also a Call matching the plain branch —
+    # without this it would be recorded twice (once as "decorated", once with
+    # an opaque binding)
+    deco_calls = {id(d)
+                  for n in ast.walk(tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  for d in n.decorator_list}
+    sites: List[StaticJitSite] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func) and \
+                id(node) not in deco_calls:
+            nums, names = _static_kwargs(node)
+            if not nums and not names:
+                continue
+            binding, name = _binding_of(node)
+            sites.append(StaticJitSite(
+                jit_call=node, static_positions=tuple(sorted(nums)),
+                static_names=names, binding=binding, name=name,
+                fn_node=_jit_target(node, tree, collector.defs)))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @jax.jit(static_argnums=...) / @partial(jax.jit, static_...=...)
+            # — the same decorator forms collect_jit_roots models; the
+            # decorated NAME is the callable every call site binds
+            for dec in node.decorator_list:
+                if not (isinstance(dec, ast.Call) and (_is_jax_jit(dec.func) or (
+                        _is_partial(dec.func) and dec.args and
+                        _is_jax_jit(dec.args[0])))):
+                    continue
+                nums, names = _static_kwargs(dec)
+                if not nums and not names:
+                    continue
+                sites.append(StaticJitSite(
+                    jit_call=dec, static_positions=tuple(sorted(nums)),
+                    static_names=names, binding="decorated", name=node.name,
+                    fn_node=node))
     return sites
 
 
@@ -329,7 +413,8 @@ class ProjectContext:
     """Facts shared by every rule over one lint invocation."""
 
     def __init__(self, modules: List[ModuleInfo], extra_declared_keys=(),
-                 api_surface: Optional[Set[str]] = None):
+                 api_surface: Optional[Set[str]] = None,
+                 mesh_manifest: Optional[Set[str]] = None):
         self.modules = modules
         self.declared_config_keys: Set[str] = set(extra_declared_keys)
         # exported name -> candidate "module:attr" spellings, read from the
@@ -338,8 +423,12 @@ class ProjectContext:
         # pinned external-API symbols from .dslint-api-surface.json; None when
         # the manifest has never been generated
         self.api_surface = api_surface
+        # pinned mesh axis names from .dslint-mesh-manifest.json; None when
+        # never generated (unknown-mesh-axis reports that as its own finding)
+        self.mesh_manifest = mesh_manifest
         self._jit_roots: Dict[str, Dict[int, JitRoot]] = {}
         self._donations: Dict[str, List[DonationSite]] = {}
+        self._static_sites: Dict[str, List[StaticJitSite]] = {}
         for mod in modules:
             annotate_parents(mod.tree)
             self.declared_config_keys |= _config_keys_from_module(mod.tree)
@@ -347,9 +436,16 @@ class ProjectContext:
                 self.shimmed_symbols.update(_shimmed_symbols_from_module(mod.tree))
             self._jit_roots[mod.relpath] = collect_jit_roots(mod)
             self._donations[mod.relpath] = collect_donation_sites(mod)
+            self._static_sites[mod.relpath] = collect_static_jit_sites(mod)
+        # deferred import: mesh_model imports ModuleInfo from this module
+        from .mesh_model import MeshModel
+        self.mesh_model = MeshModel(modules)
 
     def jit_roots(self, module: ModuleInfo) -> Dict[int, JitRoot]:
         return self._jit_roots.get(module.relpath, {})
 
     def donation_sites(self, module: ModuleInfo) -> List[DonationSite]:
         return self._donations.get(module.relpath, [])
+
+    def static_jit_sites(self, module: ModuleInfo) -> List[StaticJitSite]:
+        return self._static_sites.get(module.relpath, [])

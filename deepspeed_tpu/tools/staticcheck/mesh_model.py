@@ -1,0 +1,313 @@
+"""Cross-module mesh/axis model + the committed mesh manifest.
+
+The multichip work (sharded ``DeviceBatchState``, shard_mapped serve loop)
+lives or dies by axis annotations agreeing with the mesh they assume — and
+PR 9's GSPMD kv-projection miscompile proved the failure mode is *silent*
+(wrong logits, no error).  This module gives the rules a static model of
+every mesh/axis fact in the tree:
+
+- **declared axes** — every axis-name literal a mesh construction pins:
+  ``Mesh(grid, axis_names=("data", ...))``, ``jax.make_mesh(shape, names)``,
+  and the canonical module-level ``<NAME>_AXIS = "literal"`` constants
+  (parallel/mesh.py builds its Mesh's axis_names dynamically from exactly
+  these constants, so they ARE the static declaration);
+- **axis references** — every ``PartitionSpec(...)`` construction (bare,
+  inside ``NamedSharding``, inside ``shard_map``/pjit ``in_specs``/
+  ``out_specs`` trees) with its per-dimension entries resolved alias-aware:
+  a ``Name`` in axis position resolves through import aliases to the
+  ``*_AXIS`` constant table, so ``PartitionSpec(TENSOR_AXIS)`` in another
+  module resolves to ``"tensor"``; plus ``shard_map(..., axis_names={...})``
+  manual-axis sets;
+- the committed manifest (``.dslint-mesh-manifest.json``) of declared axis
+  names, analogous to the API-surface manifest: the ``unknown-mesh-axis``
+  rule keeps it exactly equal to the tree, so a new/renamed mesh axis lands
+  as one reviewable manifest diff.
+
+Everything is pure AST (no imports — the analyzer keeps working when jax is
+broken).  Entries that static analysis cannot resolve (computed expressions,
+``*splat``, function parameters) are marked :data:`UNRESOLVED` and skipped
+by the rules — the model never guesses.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .context import ModuleInfo, terminal_name as _terminal_name
+
+MESH_MANIFEST_VERSION = 1
+DEFAULT_MESH_MANIFEST_NAME = ".dslint-mesh-manifest.json"
+# only package files declare mesh axes for manifest purposes (tests build
+# ad-hoc meshes freely and are not scanned by the mesh rules)
+PACKAGE_PREFIX = "deepspeed_tpu/"
+
+# canonical axis-constant convention: module-level NAME_AXIS = "literal"
+AXIS_CONST_SUFFIX = "_AXIS"
+
+# sentinel for an axis position whose value static analysis cannot resolve
+UNRESOLVED = "?"
+
+# mesh-constructing callables whose axis_names are DECLARATIONS, not uses
+MESH_CTORS = {"Mesh", "make_mesh", "AbstractMesh"}
+# spec-consuming callables whose axis_names are manual-axis REFERENCES
+SHARD_MAP_NAMES = {"shard_map", "pjit"}
+# MeshTopology helpers returning a NamedSharding (parallel/mesh.py)
+SHARDING_FACTORY_METHODS = {"sharding", "replicated"}
+
+
+def is_sharding_factory(node: ast.AST) -> bool:
+    """``NamedSharding(...)`` or a topology factory producing one."""
+    if not isinstance(node, ast.Call):
+        return False
+    t = _terminal_name(node.func)
+    return t == "NamedSharding" or t in SHARDING_FACTORY_METHODS
+
+
+def _str_elts(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(literal, node) for every string constant in a tuple/list/set literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node)]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append((el.value, el))
+        return out
+    return []
+
+
+@dataclasses.dataclass
+class AxisUse:
+    """One resolved axis-name reference inside a spec/axis_names position."""
+    axis: str  # literal axis name, or UNRESOLVED
+    node: ast.AST  # anchor for findings
+    via: Optional[str] = None  # constant name it resolved through, if any
+
+
+@dataclasses.dataclass
+class SpecSite:
+    """One ``PartitionSpec(...)`` construction."""
+    call: ast.Call
+    entries: List[List[AxisUse]]  # per dim; [] = None (replicated)
+    rank: Optional[int]  # len(args), or None when *splat defeats arity
+
+    def axis_uses(self) -> Iterable[AxisUse]:
+        for dim in self.entries:
+            yield from dim
+
+
+@dataclasses.dataclass
+class MeshModuleInfo:
+    """Per-module mesh facts (alias-resolved against the global model)."""
+    spec_sites: List[SpecSite]
+    axis_name_uses: List[AxisUse]  # shard_map(axis_names={...}) references
+    # axis -> declaring nodes IN THIS MODULE (mesh ctors + *_AXIS constants):
+    # unknown-mesh-axis honors these even outside the package, so an ad-hoc
+    # mesh in a script/bench file validates its own specs
+    declarations: Dict[str, List[ast.AST]]
+    # names/attrs assigned from a NamedSharding-producing expression anywhere
+    # in the module (``rep = NamedSharding(mesh, spec)``) — shared by the
+    # sharding-dataflow rules, collected once here instead of per rule
+    sharding_var_names: Set[str]
+
+
+class MeshModel:
+    """Project-wide mesh/axis facts shared by the sharding rules."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        # CONST_NAME -> axis literal, from module-level *_AXIS = "..." (any
+        # module in context: names are project-unique by convention)
+        self.axis_constants: Dict[str, str] = {}
+        # axis literal -> [(relpath, lineno)] declaration sites (package only)
+        self.declared_axes: Dict[str, List[Tuple[str, int]]] = {}
+        # relpath -> axis -> declaring nodes there (EVERY module, package or
+        # not — module-local declarations validate that module's own specs)
+        self._module_decls: Dict[str, Dict[str, List[ast.AST]]] = {}
+        self._infos: Dict[str, MeshModuleInfo] = {}
+        for mod in modules:
+            self._collect_declarations(mod)
+        for mod in modules:
+            self._infos[mod.relpath] = self._collect_uses(mod)
+
+    # ------------------------------------------------------------ declarations
+    def _collect_declarations(self, mod: ModuleInfo) -> None:
+        in_package = mod.relpath.startswith(PACKAGE_PREFIX)
+        local = self._module_decls.setdefault(mod.relpath, {})
+
+        def declare(axis: str, node: ast.AST) -> None:
+            local.setdefault(axis, []).append(node)
+            if in_package:
+                self.declared_axes.setdefault(axis, []).append(
+                    (mod.relpath, node.lineno))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id.endswith(AXIS_CONST_SUFFIX) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                # canonical axis constant — both an alias source and (in
+                # package code) a declaration
+                self.axis_constants.setdefault(node.targets[0].id,
+                                               node.value.value)
+                declare(node.value.value, node.value)
+            elif isinstance(node, ast.Call) and \
+                    _terminal_name(node.func) in MESH_CTORS:
+                for axis, anode in self._ctor_axis_names(node):
+                    declare(axis, anode)
+
+    def _ctor_axis_names(self, call: ast.Call) -> List[Tuple[str, ast.AST]]:
+        """Literal axis names a Mesh/make_mesh construction declares."""
+        out: List[Tuple[str, ast.AST]] = []
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                out.extend(_str_elts(kw.value))
+        # positional: Mesh(devices, names) / make_mesh(shape, names)
+        if len(call.args) >= 2:
+            out.extend(_str_elts(call.args[1]))
+        return out
+
+    # -------------------------------------------------------------------- uses
+    def _collect_uses(self, mod: ModuleInfo) -> MeshModuleInfo:
+        import_aliases: Dict[str, str] = {}  # local name -> imported name
+        ps_names: Set[str] = {"PartitionSpec"}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name.endswith(AXIS_CONST_SUFFIX):
+                        import_aliases[alias.asname or alias.name] = alias.name
+                    elif alias.name == "PartitionSpec" and alias.asname:
+                        ps_names.add(alias.asname)
+
+        def resolve(node: ast.AST) -> Optional[AxisUse]:
+            if isinstance(node, ast.Constant):
+                if node.value is None:
+                    return None
+                if isinstance(node.value, str):
+                    return AxisUse(axis=node.value, node=node)
+                return AxisUse(axis=UNRESOLVED, node=node)
+            name = _terminal_name(node)
+            if name is not None:
+                const = import_aliases.get(name, name)
+                literal = self.axis_constants.get(const)
+                if literal is not None:
+                    return AxisUse(axis=literal, node=node, via=const)
+            return AxisUse(axis=UNRESOLVED, node=node)
+
+        def parse_entry(arg: ast.AST) -> List[AxisUse]:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                uses = []
+                for el in arg.elts:
+                    use = resolve(el)
+                    if use is not None:
+                        uses.append(use)
+                return uses
+            use = resolve(arg)
+            return [use] if use is not None else []
+
+        spec_sites: List[SpecSite] = []
+        axis_name_uses: List[AxisUse] = []
+        sharding_vars: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], (ast.Name, ast.Attribute)) and \
+                    is_sharding_factory(node.value):
+                sharding_vars.add(ast.unparse(node.targets[0]))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _terminal_name(node.func)
+            if fname in ps_names:
+                entries: List[List[AxisUse]] = []
+                rank: Optional[int] = len(node.args)
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        rank = None  # *dims defeats static arity
+                        continue
+                    entries.append(parse_entry(arg))
+                spec_sites.append(SpecSite(call=node, entries=entries, rank=rank))
+            elif fname in SHARD_MAP_NAMES:
+                for kw in node.keywords:
+                    if kw.arg != "axis_names":
+                        continue
+                    if isinstance(kw.value, (ast.Tuple, ast.List, ast.Set)):
+                        for el in kw.value.elts:
+                            use = resolve(el)
+                            if use is not None:
+                                axis_name_uses.append(use)
+                    else:
+                        use = resolve(kw.value)
+                        if use is not None:
+                            axis_name_uses.append(use)
+        return MeshModuleInfo(spec_sites=spec_sites,
+                              axis_name_uses=axis_name_uses,
+                              declarations=self._module_decls.get(mod.relpath, {}),
+                              sharding_var_names=sharding_vars)
+
+    # --------------------------------------------------------------- accessors
+    def module_info(self, module: ModuleInfo) -> MeshModuleInfo:
+        info = self._infos.get(module.relpath)
+        if info is None:  # module outside the context set (shouldn't happen)
+            self._collect_declarations(module)
+            info = self._collect_uses(module)
+            self._infos[module.relpath] = info
+        return info
+
+    def declared_axis_names(self) -> Set[str]:
+        return set(self.declared_axes)
+
+
+def collect_mesh_axes(modules: Iterable[ModuleInfo]) -> Set[str]:
+    """The package's declared mesh axes (manifest regeneration)."""
+    return MeshModel(list(modules)).declared_axis_names()
+
+
+def load_mesh_manifest(path: str) -> Optional[Set[str]]:
+    """Pinned axis names; None when the manifest has never been generated."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("version") != MESH_MANIFEST_VERSION:
+        raise ValueError(f"{path}: not a dslint mesh manifest "
+                         f"(expected version={MESH_MANIFEST_VERSION})")
+    return set(data.get("axes", []))
+
+
+def save_mesh_manifest(path: str, axes: Set[str]) -> None:
+    with open(path, "w") as fh:
+        json.dump({"version": MESH_MANIFEST_VERSION, "axes": sorted(axes)},
+                  fh, indent=1)
+        fh.write("\n")
+
+
+# ------------------------------------------------------- static shape helpers
+# array-creation callables whose first argument is the shape — the only rank
+# source spec-rank-mismatch trusts (everything else is rank-unknown, skipped)
+CREATION_FNS = {"zeros", "ones", "empty", "full"}
+
+
+def shape_rank(shape: ast.AST) -> Optional[int]:
+    """Rank implied by a literal shape expression — the ONE definition of
+    "statically-known shape" (creation calls, make_array_from_callback)."""
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        if any(isinstance(el, ast.Starred) for el in shape.elts):
+            return None
+        return len(shape.elts)
+    if isinstance(shape, ast.Constant) and isinstance(shape.value, int):
+        return 1
+    return None
+
+
+def creation_rank(expr: ast.AST) -> Optional[int]:
+    """Statically-known rank of an array-creation expression, else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = _terminal_name(expr.func)
+    if name in CREATION_FNS and expr.args:
+        return shape_rank(expr.args[0])
+    if name == "arange":
+        return 1
+    return None

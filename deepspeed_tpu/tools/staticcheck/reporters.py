@@ -1,8 +1,12 @@
-"""Text and JSON reporters for lint results."""
+"""Text, JSON and SARIF reporters for lint results."""
 
 import json
 
 from .runner import LintResult
+from .rules import META_RULES, RULES
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def report_text(result: LintResult) -> str:
@@ -25,4 +29,57 @@ def report_json(result: LintResult) -> str:
         "findings": [f.to_dict() for f in result.findings],
         "baselined": [f.to_dict() for f in result.baselined],
         "summary": result.summary(),
+    }, indent=1)
+
+
+def report_sarif(result: LintResult) -> str:
+    """SARIF 2.1.0 — what CI annotation surfaces (GitHub code scanning et
+    al.) ingest to render findings inline on the diff.  Only ACTIVE findings
+    are emitted (baselined debt would re-annotate every PR); the dslint
+    fingerprint rides in partialFingerprints so upload dedup matches the
+    baseline's identity, and rule metadata comes from the live registry so
+    the catalog can't drift from the code."""
+    rule_ids = sorted({f.rule for f in result.findings} |
+                      set(result.rules_run))
+    rules_meta = []
+    for rid in rule_ids:
+        if rid in RULES:
+            desc = RULES[rid].description
+        else:
+            desc = META_RULES.get(rid, "")
+        rules_meta.append({"id": rid,
+                           "shortDescription": {"text": desc or rid}})
+    index_of = {m["id"]: i for i, m in enumerate(rules_meta)}
+    results = []
+    for f in result.findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index_of.get(f.rule, -1),
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "partialFingerprints": {"dslintFingerprint/v1": f.fingerprint},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1,
+                               "endLine": max(f.end_line, f.line),
+                               "snippet": {"text": f.snippet}},
+                },
+            }],
+        })
+    return json.dumps({
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                # informationUri must be an ABSOLUTE URI per SARIF 2.1.0 —
+                # omitted (optional) rather than risking strict-consumer
+                # rejection on a relative path
+                "name": "dslint",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
     }, indent=1)

@@ -30,6 +30,26 @@ suppression reasons left in-tree for the survivors):
 - undeclared-config-key: string keys read from config dicts that no
   ``ConfigModel`` schema declares — a typo'd key silently falls back to its
   default instead of erroring.
+- unknown-mesh-axis: a ``PartitionSpec``/``in_specs``/``axis_names`` axis
+  literal no declared mesh defines — the typo class behind the PR 9 GSPMD
+  kv-projection MISCOMPILE (wrong logits, no error); declared axes are
+  pinned in a committed manifest (``.dslint-mesh-manifest.json``).
+- sharding-dropped-at-boundary: a NamedSharding-placed value flowing into
+  ``np.asarray``/``jax.device_get``/``jnp.asarray``-without-device or a
+  fresh un-annotated ``device_put`` — the placement silently collapses to a
+  single device (the exact gap keeping DeviceBatchState off the multichip
+  fast path, engine_v2.py step()).
+- spec-rank-mismatch: a PartitionSpec with more dimensions than the array it
+  annotates provably has — GSPMD rejects it at runtime on the first
+  multichip mesh, long after the single-chip tests went green.
+- recompile-risk: request/batch-cardinality expressions (``len(...)``)
+  reaching a jit static argument or a padded-shape construction under
+  ``inference/v2/`` without passing through the bucketing helpers — each
+  distinct value mints a fresh compiled program, breaking the zero-warm-
+  recompiles invariant the fastpath smoke only observes after the fact.
+- donation-sharding-mismatch: a donated argument rebound to a
+  differently-specced placement — donation aliasing needs identical
+  shardings, so the "in-place" update silently degrades to a copy.
 """
 
 import ast
@@ -38,10 +58,21 @@ from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 from .api_surface import (DEFAULT_MANIFEST_NAME, PACKAGE_PREFIX,
                           collect_api_surface, symbol_sites)
 from .context import (COMPAT_PATH_FRAGMENT, ModuleInfo, ProjectContext, enclosing,
-                      enclosing_statement, param_names, parent)
+                      enclosing_statement, param_names, parent,
+                      terminal_name as _terminal_name)
 from .findings import Finding
+from .mesh_model import (CREATION_FNS as MESH_CREATION_FNS,
+                         DEFAULT_MESH_MANIFEST_NAME, SHARDING_FACTORY_METHODS,
+                         UNRESOLVED, creation_rank,
+                         is_sharding_factory as _is_sharding_factory,
+                         shape_rank)
 
 RULES: Dict[str, type] = {}
+
+# the conventional numpy/jnp import aliases — ONE definition shared by every
+# rule that matches module-qualified calls (host-sync, boundary, recompile)
+NP_MODULE_NAMES = {"np", "numpy", "onp"}
+JNP_MODULE_NAMES = {"jnp"}
 
 # meta findings emitted by the runner itself (documented for --list-rules)
 META_RULES = {
@@ -88,14 +119,6 @@ def _walk_skipping(root: ast.AST, skip: Set[int]) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
-def _terminal_name(node: ast.AST) -> Optional[str]:
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
-
-
 # --------------------------------------------------------------------------
 @register
 class HostSyncInHotPath(Rule):
@@ -118,7 +141,7 @@ class HostSyncInHotPath(Rule):
     HOT_NAMES = {"train_batch", "_offload_train_batch", "eval_batch",
                  "decode_burst", "train_step"}
     ENGINE_METHOD_NAMES = {"step"}  # hot only when defined on an *Engine class
-    NP_NAMES = {"np", "numpy", "onp"}
+    NP_NAMES = NP_MODULE_NAMES
     # the v2 serving package defers every step-result fetch through
     # fastpath.materialize() (counted + auditable); a direct fetch anywhere
     # else in inference/v2/ is an unsanctioned host sync even outside the
@@ -787,6 +810,615 @@ class JaxApiSurface(Rule):
                 f"drift is a one-file diff; if this use is deliberate, "
                 f"regenerate the manifest with 'bin/dstpu-lint "
                 f"--update-api-surface' (and review the diff)")
+
+
+# -------------------------------------------------------- sharding dataflow
+# callables that PLACE a value with an explicit sharding; "place" is this
+# repo's own pytree placement helper (inference/v2/tp.py)
+PLACEMENT_FNS = {"device_put", "make_array_from_callback", "place"}
+
+
+def _is_sharding_expr(node: ast.AST, sharding_names: Set[str]) -> bool:
+    if _is_sharding_factory(node):
+        return True
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return ast.unparse(node) in sharding_names
+    return False
+
+
+def _placement_value(node: ast.AST, sharding_names: Set[str]) -> bool:
+    """True when ``node`` is a call that places its input with an explicit
+    sharding: ``jax.device_put(x, <sharding>)``, ``make_array_from_callback``
+    with a sharding argument, or the repo's ``place(topology, tree, specs)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    t = _terminal_name(node.func)
+    if t == "device_put":
+        if len(node.args) >= 2 and _is_sharding_expr(node.args[1], sharding_names):
+            return True
+        return any(kw.arg in ("device", "sharding") and
+                   _is_sharding_expr(kw.value, sharding_names)
+                   for kw in node.keywords)
+    if t == "make_array_from_callback":
+        return any(_is_sharding_expr(a, sharding_names) for a in node.args) or \
+            any(_is_sharding_expr(kw.value, sharding_names) for kw in node.keywords)
+    # tp.py's place(topology, tree, specs) — the arity keeps unrelated
+    # .place() helpers (a grid placement, a scheduler slot) from matching
+    return t == "place" and len(node.args) >= 3
+
+
+def _calls_of_name(scope: ast.AST, name: str, attribute: bool) -> Iterator[ast.Call]:
+    """Calls of a local (``fn(...)``) or attribute (``self.fn(...)``) binding."""
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if attribute and isinstance(f, ast.Attribute) and f.attr == name:
+            yield node
+        elif not attribute and isinstance(f, ast.Name) and f.id == name:
+            yield node
+
+
+def _scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    """The module itself plus every function def (each analyzed with its
+    nested defs skipped, so one statement belongs to exactly one scope)."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``scope`` without descending into nested function defs."""
+    nested = {id(n) for n in ast.walk(scope)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and n is not scope}
+    yield from _walk_skipping(scope, nested)
+
+
+# --------------------------------------------------------------------------
+@register
+class UnknownMeshAxis(Rule):
+    name = "unknown-mesh-axis"
+    description = ("PartitionSpec/in_specs/axis_names axis literal no declared "
+                   "mesh defines (alias-aware: *_AXIS constants resolve "
+                   "cross-module) — the typo class behind the PR 9 GSPMD "
+                   "kv-projection miscompile; declared axes are pinned in the "
+                   f"committed {DEFAULT_MESH_MANIFEST_NAME} manifest "
+                   "(regenerate after a deliberate mesh change with "
+                   "bin/dstpu-lint --update-mesh-manifest)")
+
+    def __init__(self):
+        self._missing_reported = False
+        self._sync_reported = False
+
+    def check(self, module, ctx):
+        info = ctx.mesh_model.module_info(module)
+        uses = [u for site in info.spec_sites for u in site.axis_uses()]
+        uses += list(info.axis_name_uses)
+        declared = ctx.mesh_model.declared_axis_names()
+        if ctx.mesh_manifest is None:
+            if uses and not self._missing_reported:
+                self._missing_reported = True
+                # the three manifest-level findings share rule+path+line, so
+                # each carries a distinct snippet: fingerprints must differ or
+                # one baseline entry / SARIF upload dedup swallows another
+                yield Finding(
+                    rule=self.name, path=DEFAULT_MESH_MANIFEST_NAME, line=1, col=0,
+                    snippet="mesh-manifest-missing",
+                    message=f"mesh manifest {DEFAULT_MESH_MANIFEST_NAME} does not "
+                            f"exist — generate it once with 'bin/dstpu-lint "
+                            f"--update-mesh-manifest' and commit it; without it "
+                            f"the tree's mesh axis names are unpinned and an "
+                            f"axis typo lands as a silent GSPMD miscompile "
+                            f"instead of a lint error")
+            return
+        if not self._sync_reported:
+            self._sync_reported = True
+            unpinned = sorted(declared - ctx.mesh_manifest)
+            if unpinned:
+                yield Finding(
+                    rule=self.name, path=DEFAULT_MESH_MANIFEST_NAME, line=1, col=0,
+                    snippet="mesh-manifest-unpinned",
+                    message=f"mesh axis(es) declared in the tree but not pinned "
+                            f"in {DEFAULT_MESH_MANIFEST_NAME}: "
+                            f"{', '.join(unpinned)} — after a deliberate mesh "
+                            f"change regenerate with 'bin/dstpu-lint "
+                            f"--update-mesh-manifest' (and review the diff)")
+            stale = sorted(ctx.mesh_manifest - declared)
+            if stale:
+                yield Finding(
+                    rule=self.name, path=DEFAULT_MESH_MANIFEST_NAME, line=1, col=0,
+                    snippet="mesh-manifest-stale",
+                    message=f"{len(stale)} pinned mesh axis(es) no longer "
+                            f"declared anywhere in the tree "
+                            f"({', '.join(stale)}) — the manifest must stay "
+                            f"exact; regenerate with 'bin/dstpu-lint "
+                            f"--update-mesh-manifest'",
+                    severity="warning")
+        # module-local declarations count too: an ad-hoc Mesh in a script or
+        # bench file validates that file's own specs without entering the
+        # package manifest
+        known = declared | ctx.mesh_manifest | set(info.declarations)
+        for u in uses:
+            if u.axis == UNRESOLVED or u.axis in known:
+                continue
+            via = f" (via constant {u.via})" if u.via else ""
+            yield self.finding(
+                module, u.node,
+                f"mesh axis '{u.axis}'{via} is not declared by any Mesh/"
+                f"make_mesh construction or *_AXIS constant "
+                f"(declared: {', '.join(sorted(known)) or 'none'}) — an axis "
+                f"typo in a PartitionSpec does not error at trace time, it "
+                f"silently changes the GSPMD partitioning (the PR 9 "
+                f"kv-projection miscompile class); fix the spelling, or "
+                f"declare the axis and re-pin with 'bin/dstpu-lint "
+                f"--update-mesh-manifest'")
+
+
+# --------------------------------------------------------------------------
+@register
+class ShardingDroppedAtBoundary(Rule):
+    name = "sharding-dropped-at-boundary"
+    description = ("NamedSharding-placed value flowing into np.asarray/"
+                   "jax.device_get/jnp.asarray-without-device or a fresh "
+                   "un-annotated device_put — the placement silently collapses "
+                   "to a single device (the exact gap keeping DeviceBatchState "
+                   "off the multichip fast path)")
+
+    def check(self, module, ctx):
+        sharding_names = ctx.mesh_model.module_info(module).sharding_var_names
+        for scope in _scopes(module.tree):
+            yield from self._check_locals(module, scope, sharding_names)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class_attrs(module, node, sharding_names)
+
+    def _drop_call(self, call: ast.Call):
+        """(dropped-arg node, message) when ``call`` collapses a placement."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and call.args):
+            return None
+        owner = f.value.id
+        if f.attr in ("asarray", "array") and owner in NP_MODULE_NAMES:
+            return call.args[0], f"{owner}.{f.attr}() pulls the placed value to host"
+        if f.attr == "device_get" and owner == "jax":
+            return call.args[0], "jax.device_get() pulls the placed value to host"
+        has_device = any(kw.arg in ("device", "sharding") for kw in call.keywords)
+        if f.attr == "asarray" and owner in JNP_MODULE_NAMES and not has_device:
+            return call.args[0], ("jnp.asarray() without device= re-commits the "
+                                  "value without its NamedSharding")
+        if f.attr == "device_put" and owner == "jax" and len(call.args) == 1 \
+                and not has_device:
+            return call.args[0], ("jax.device_put() without a sharding commits "
+                                  "the value to the default single device")
+        return None
+
+    def _finding(self, module, node, expr, how, placed_line):
+        return self.finding(
+            module, node,
+            f"{how}: '{expr}' was placed with a NamedSharding (line "
+            f"{placed_line}) and this boundary silently collapses it to "
+            f"single-device — under a TP/DP mesh the next sharded computation "
+            f"either gathers the world or miscompiles (the DeviceBatchState "
+            f"commit-path gap that forces tp>1 serving onto the slow path); "
+            f"carry the sharding across the boundary (device=..., an explicit "
+            f"NamedSharding arg) or suppress with a reason if this collapse "
+            f"is deliberate (checkpoint-save host serialization, init-time "
+            f"staging)")
+
+    def _check_locals(self, module, scope, sharding_names):
+        """Linear scan: placement stores, unbinding stores, drop calls —
+        within one line drops (loads of the old value) order before stores.
+        ANY store of a name (for target, with-as, tuple unpack) unbinds it:
+        a placed name reused as a loop variable is no longer the placement."""
+        events = []
+        modeled: Set[int] = set()
+        for node in _own_nodes(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                modeled.add(id(node.targets[0]))
+                kind = "place" if _placement_value(node.value, sharding_names) \
+                    else "unbind"
+                events.append((node.lineno, kind, node.targets[0].id, node))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    id(node) not in modeled:
+                # parents precede children in the walk, so modeled targets
+                # are already excluded here
+                events.append((node.lineno, "unbind", node.id, node))
+            elif isinstance(node, ast.Call):
+                hit = self._drop_call(node)
+                if hit is not None:
+                    arg, how = hit
+                    if isinstance(arg, ast.Name):
+                        events.append((node.lineno, "drop", arg.id, (node, how)))
+        events.sort(key=lambda e: (e[0], 0 if e[1] == "drop" else 1))
+        placed: Dict[str, int] = {}
+        for line, kind, name, payload in events:
+            if kind == "place":
+                placed[name] = line
+            elif kind == "unbind":
+                placed.pop(name, None)
+            elif name in placed:
+                node, how = payload
+                yield self._finding(module, node, name, how, placed[name])
+
+    def _check_class_attrs(self, module, cls, sharding_names):
+        """Cross-method attribute flow: ``self.x`` placed in one method (the
+        __init__-placement / step-drop split is where the real serving bug
+        lives) and collapsed in another — no line ordering, the placement is
+        the attribute's steady state."""
+        placed: Dict[str, int] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Attribute) and \
+                    _placement_value(node.value, sharding_names):
+                placed.setdefault(ast.unparse(node.targets[0]), node.lineno)
+        if not placed:
+            return
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._drop_call(node)
+            if hit is None:
+                continue
+            arg, how = hit
+            if not isinstance(arg, ast.Attribute):
+                continue
+            expr = ast.unparse(arg)
+            if expr in placed:
+                yield self._finding(module, node, expr, how, placed[expr])
+
+
+# --------------------------------------------------------------------------
+@register
+class SpecRankMismatch(Rule):
+    name = "spec-rank-mismatch"
+    description = ("PartitionSpec with more dimensions than the annotated "
+                   "array's statically-known rank — over-ranked specs are a "
+                   "runtime error on the first real multichip mesh, long "
+                   "after single-chip tests went green")
+
+    def check(self, module, ctx):
+        info = ctx.mesh_model.module_info(module)
+        site_rank = {id(s.call): s.rank for s in info.spec_sites}
+        for scope in _scopes(module.tree):
+            yield from self._check_scope(module, scope, site_rank)
+
+    def _spec_rank(self, expr, site_rank, spec_vars, shard_vars):
+        """Rank of a spec/sharding expression, else None."""
+        if isinstance(expr, ast.Call):
+            t = _terminal_name(expr.func)
+            if t == "NamedSharding" and len(expr.args) >= 2:
+                return self._spec_rank(expr.args[1], site_rank, spec_vars,
+                                       shard_vars)
+            if id(expr) in site_rank:
+                return site_rank[id(expr)]
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in spec_vars:
+                return spec_vars[expr.id]
+            return shard_vars.get(expr.id)
+        return None
+
+    def _check_scope(self, module, scope, site_rank):
+        value_rank: Dict[str, int] = {}
+        spec_vars: Dict[str, int] = {}
+        shard_vars: Dict[str, int] = {}
+        # ONE source-ordered linear scan (the tree walk is not source-ordered):
+        # spec-variable chains resolve, and a rebind to an unknown-rank value
+        # INVALIDATES the name instead of leaving a stale "provable" rank —
+        # within a line, calls order before stores (args evaluate first).
+        # ANY other store of the name (for target, with-as, tuple unpack,
+        # augmented assign) also invalidates: kinds call=0, invalidate=1,
+        # modeled-assign=2
+        events = []
+        modeled: Set[int] = set()
+        for node in _own_nodes(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                modeled.add(id(node.targets[0]))
+                events.append((node.lineno, 2, node))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    id(node) not in modeled:
+                # parents precede children in the walk, so a modeled assign's
+                # own target Name is already excluded here
+                events.append((node.lineno, 1, node))
+            elif isinstance(node, ast.Call) and \
+                    _terminal_name(node.func) in ("device_put",
+                                                  "make_array_from_callback") \
+                    and len(node.args) >= 2:
+                events.append((node.lineno, 0, node))
+        for _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == 1:
+                for table in (value_rank, spec_vars, shard_vars):
+                    table.pop(node.id, None)
+                continue
+            if kind == 2:
+                tgt, val = node.targets[0].id, node.value
+                for table in (value_rank, spec_vars, shard_vars):
+                    table.pop(tgt, None)
+                rank = creation_rank(val)
+                if rank is not None:
+                    value_rank[tgt] = rank
+                    continue
+                srank = self._spec_rank(val, site_rank, spec_vars, shard_vars)
+                if srank is not None:
+                    if isinstance(val, ast.Call) and \
+                            _terminal_name(val.func) == "NamedSharding":
+                        shard_vars[tgt] = srank
+                    else:
+                        spec_vars[tgt] = srank
+                continue
+            if _terminal_name(node.func) == "device_put":
+                vrank = self._value_rank(node.args[0], value_rank)
+            else:
+                vrank = shape_rank(node.args[0])
+            srank = self._spec_rank(node.args[1], site_rank, spec_vars,
+                                    shard_vars)
+            if vrank is None or srank is None or srank <= vrank:
+                continue
+            yield self.finding(
+                module, node,
+                f"PartitionSpec names {srank} dimension(s) but the annotated "
+                f"array is provably rank {vrank} — an over-ranked spec is "
+                f"rejected at placement time on a real multichip mesh (and "
+                f"nothing catches it on the single-device CPU lane); trim the "
+                f"spec — trailing dimensions replicate implicitly")
+
+    def _value_rank(self, expr, value_rank) -> Optional[int]:
+        rank = creation_rank(expr)
+        if rank is not None:
+            return rank
+        if isinstance(expr, ast.Name):
+            return value_rank.get(expr.id)
+        return None
+
+
+# --------------------------------------------------------------------------
+@register
+class RecompileRisk(Rule):
+    name = "recompile-risk"
+    description = ("request/batch-cardinality expression (len/sum of runtime "
+                   "state) reaching a jit static argument or a padded-shape "
+                   "array construction under inference/v2/ without passing "
+                   "through the bucketing helpers — each distinct value mints "
+                   "a fresh compiled program, breaking the zero-warm-"
+                   "recompiles invariant the fastpath smoke only observes "
+                   "after the fact")
+
+    V2_PATH_FRAGMENT = "inference/v2/"
+    DYNAMIC_CALLS = {"len", "sum"}
+    # the sanctioned cardinality->shape launders: one shared pow2 bucketer +
+    # the engine's table-width stepper (engine_v2/fastpath)
+    SANCTIFIERS = {"round_up_pow2", "_bucket", "_stepped_width"}
+    CREATION_OWNERS = NP_MODULE_NAMES | JNP_MODULE_NAMES
+    CREATION_FNS = MESH_CREATION_FNS  # one definition of "array creation"
+
+    def check(self, module, ctx):
+        if self.V2_PATH_FRAGMENT not in module.relpath.replace("\\", "/"):
+            return
+        yield from self._check_static_args(module, ctx)
+        yield from self._check_shape_constructions(module)
+
+    # ---- leg a: static jit arguments
+    def _check_static_args(self, module, ctx):
+        for site in ctx.static_jit_sites(module):
+            offset = 0
+            if site.binding == "local":
+                fn = enclosing(site.jit_call, ast.FunctionDef, ast.AsyncFunctionDef)
+                scope = fn if fn is not None else module.tree
+                calls = _calls_of_name(scope, site.name, attribute=False)
+            elif site.binding == "attribute":
+                calls = _calls_of_name(module.tree, site.name, attribute=True)
+            elif site.binding == "decorated":
+                # @jax.jit(...)-decorated def: calls bind the decorated NAME —
+                # bare for a module-level function, self.<name> for a method
+                # (where bound calls shift static_argnums left past `self`)
+                is_method = enclosing(site.fn_node, ast.ClassDef) is not None
+                offset = 1 if is_method else 0
+                calls = _calls_of_name(module.tree, site.name,
+                                       attribute=is_method)
+            else:
+                continue
+            for call in calls:
+                if call is site.jit_call:
+                    continue
+                for pos in site.static_positions:
+                    if pos - offset >= 0 and pos - offset < len(call.args):
+                        yield from self._check_expr(module, call.args[pos - offset],
+                                                    f"static position {pos}")
+                for kw in call.keywords:
+                    if kw.arg in site.static_names:
+                        yield from self._check_expr(module, kw.value,
+                                                    f"static argument '{kw.arg}'")
+
+    def _check_expr(self, module, expr, where: str):
+        dyn = self._dynamic_node(expr)
+        if dyn is None:
+            return
+        yield self.finding(
+            module, dyn,
+            f"'{ast.unparse(dyn)}' — a runtime-cardinality value — reaches "
+            f"{where} of a jitted callable: every distinct value traces and "
+            f"compiles a FRESH program, so steady-state serving recompiles "
+            f"exactly when load shifts (the warm-recompile stall the fastpath "
+            f"smoke's zero-warm-recompiles counter only observes after the "
+            f"fact); bucket it through round_up_pow2/_bucket/_stepped_width "
+            f"first, or make the argument traced")
+
+    # ---- leg b: padded-shape constructions
+    def _check_shape_constructions(self, module):
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in self.CREATION_FNS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in self.CREATION_OWNERS):
+                continue
+            dyn = self._dynamic_node(node.args[0])
+            if dyn is None:
+                continue
+            yield self.finding(
+                module, dyn,
+                f"array shape derived from raw runtime cardinality "
+                f"'{ast.unparse(dyn)}' — this buffer's shape changes with "
+                f"load, and every new shape that reaches a jitted program is "
+                f"a fresh compile; pad through round_up_pow2/_bucket/"
+                f"_stepped_width (the shared bucketing primitives) instead")
+
+    def _dynamic_node(self, expr) -> Optional[ast.AST]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and \
+                    _terminal_name(node.func) in self.DYNAMIC_CALLS and \
+                    not self._sanctified(node, expr):
+                return node
+        return None
+
+    def _sanctified(self, node, stop) -> bool:
+        """A bucketing call strictly WITHIN the checked expression encloses
+        ``node``.  The walk must not escape ``stop``: bucketing the RESULT of
+        a jitted call (``round_up_pow2(fn(len(x)))``) does nothing for the
+        static argument inside it."""
+        if node is stop:
+            return False
+        cur = parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call) and \
+                    _terminal_name(cur.func) in self.SANCTIFIERS:
+                return True
+            if cur is stop:
+                return False
+            cur = parent(cur)
+        return False
+
+
+# --------------------------------------------------------------------------
+@register
+class DonationShardingMismatch(Rule):
+    name = "donation-sharding-mismatch"
+    description = ("argument donated to a jitted callable rebound to a "
+                   "differently-specced placement — donation aliasing needs "
+                   "identical shardings, so the in-place update silently "
+                   "degrades to a copy (and a recompile)")
+
+    def check(self, module, ctx):
+        info = ctx.mesh_model.module_info(module)
+        sharding_names = info.sharding_var_names
+        site_key = {id(s.call): self._site_key(s) for s in info.spec_sites}
+        donated = self._donated_exprs(module, ctx)
+        if not donated:
+            return
+        for scope in _scopes(module.tree):
+            yield from self._check_scope(module, scope, donated, site_key,
+                                         sharding_names, attr_mode=False)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_scope(module, node, donated, site_key,
+                                             sharding_names, attr_mode=True)
+
+    def _site_key(self, site):
+        """Canonical identity of a spec: resolved axis tuples with trailing
+        replicated dims stripped (PartitionSpec('x') == PartitionSpec('x',
+        None)); unresolved entries fall back to textual identity."""
+        if site.rank is None or any(u.axis == UNRESOLVED
+                                    for u in site.axis_uses()):
+            return ast.unparse(site.call)
+        dims = [tuple(u.axis for u in dim) for dim in site.entries]
+        while dims and not dims[-1]:
+            dims.pop()
+        return tuple(dims)
+
+    def _donated_exprs(self, module, ctx) -> Set[str]:
+        out: Set[str] = set()
+        for site in ctx.donation_sites(module):
+            if site.binding == "local":
+                fn = enclosing(site.jit_call, ast.FunctionDef, ast.AsyncFunctionDef)
+                scope = fn if fn is not None else module.tree
+                attribute = False
+            elif site.binding == "attribute":
+                scope, attribute = module.tree, True
+            else:
+                continue
+            for call in _calls_of_name(scope, site.name, attribute=attribute):
+                for idx in site.donated:
+                    if idx < len(call.args) and \
+                            isinstance(call.args[idx], (ast.Name, ast.Attribute)):
+                        out.add(ast.unparse(call.args[idx]))
+        return out
+
+    def _placement_key(self, value, site_key, sharding_names):
+        """Spec identity of a placement expression, else None."""
+        if not _placement_value(value, sharding_names):
+            return None
+        t = _terminal_name(value.func)
+        if t == "device_put" and len(value.args) >= 2:
+            return self._sharding_key(value.args[1], site_key)
+        if t == "make_array_from_callback":
+            for a in list(value.args) + [kw.value for kw in value.keywords]:
+                key = self._sharding_key(a, site_key)
+                if key is not None:
+                    return key
+        return None
+
+    def _sharding_key(self, expr, site_key):
+        if isinstance(expr, ast.Call):
+            t = _terminal_name(expr.func)
+            if t == "NamedSharding" and len(expr.args) >= 2:
+                spec = expr.args[1]
+                if isinstance(spec, ast.Call) and id(spec) in site_key:
+                    return site_key[id(spec)]
+                return None  # spec via a variable/attr: the model never guesses
+            if t in SHARDING_FACTORY_METHODS and expr.args:
+                spec = expr.args[0]
+                if isinstance(spec, ast.Call) and id(spec) in site_key:
+                    return site_key[id(spec)]
+                return None
+        return None
+
+    def _check_scope(self, module, scope, donated, site_key, sharding_names,
+                     attr_mode: bool):
+        placements: Dict[str, Tuple[object, int]] = {}  # expr -> (key, line)
+        nodes = ast.walk(scope) if attr_mode else _own_nodes(scope)
+        # the tree walks are not source-ordered — sort, or the finding anchors
+        # on the ORIGINAL placement and cites the rebind as "its placement"
+        assigns = sorted(
+            (n for n in nodes
+             if isinstance(n, ast.Assign) and len(n.targets) == 1),
+            key=lambda n: n.lineno)
+        for node in assigns:
+            tgt = node.targets[0]
+            if attr_mode and not isinstance(tgt, ast.Attribute):
+                continue
+            if not attr_mode and not isinstance(tgt, ast.Name):
+                continue
+            expr = ast.unparse(tgt)
+            if expr not in donated:
+                continue
+            key = self._placement_key(node.value, site_key, sharding_names)
+            if key is None:
+                continue
+            prev = placements.get(expr)
+            # flag only when BOTH specs resolved to canonical axis tuples —
+            # a textual fallback key (unresolved spec site) can't prove a
+            # genuine mismatch against a resolved one
+            if prev is not None and prev[0] != key and \
+                    isinstance(prev[0], tuple) and isinstance(key, tuple):
+                yield self.finding(
+                    module, node.value,
+                    f"'{expr}' is DONATED to a jitted callable but rebound "
+                    f"here with a different sharding than its placement at "
+                    f"line {prev[1]} — XLA only aliases a donated buffer when "
+                    f"the sharding matches the compiled expectation, so this "
+                    f"donation silently degrades to a full copy (plus a "
+                    f"recompile for the new layout); keep one spec for the "
+                    f"donated value's lifetime or drop the donation")
+            else:
+                placements[expr] = (key, node.lineno)
 
 
 def build_rules(enabled: Optional[Iterable[str]] = None,

@@ -4,13 +4,15 @@ suppressions and the baseline."""
 import ast
 import dataclasses
 import os
+import subprocess
 import time
-from typing import Dict, Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Set
 
 from .api_surface import DEFAULT_MANIFEST_NAME, load_api_surface
 from .baseline import apply_baseline
 from .context import ModuleInfo, ProjectContext
 from .findings import Finding
+from .mesh_model import DEFAULT_MESH_MANIFEST_NAME, load_mesh_manifest
 from .rules import RULES, Rule, build_rules
 from .suppressions import SuppressionIndex, parse_suppressions
 
@@ -62,6 +64,73 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
+# the default scan roots, shared with the CLI: --changed keeps its findings
+# identical to what the full `dstpu-lint` run reports, so it must not pull in
+# repo files (bench/scripts/conftest) the full run never lints
+DEFAULT_SCAN_DIRS = ("deepspeed_tpu", "tests")
+
+
+def changed_python_files(root: str, base: str) -> List[str]:
+    """Absolute paths of ``.py`` files changed vs the git ``base`` — committed
+    diff, working-tree edits, and untracked files; deletions drop out; scoped
+    to the default scan roots under ``root``.  Powers ``dstpu-lint --changed
+    [BASE]``: subset lints still build whole-package context (run_lint below),
+    so a changed-files run reports exactly what the full run would report for
+    those files."""
+    # `git diff --name-only` prints paths relative to the repo TOPLEVEL, which
+    # is not necessarily `root` (package in a monorepo subdir, or invoked from
+    # inside the tree) — resolve against the toplevel or every committed
+    # change silently drops out of the file set
+    top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                         cwd=root, capture_output=True, text=True)
+    if top.returncode != 0:
+        raise ValueError(f"not a git repository: "
+                         f"{top.stderr.strip() or top.stdout.strip()}")
+    toplevel = top.stdout.strip()
+    # diff vs the MERGE-BASE, not BASE itself: with BASE=origin/main on a
+    # branch that is behind upstream, a two-dot diff would pull in every file
+    # changed only upstream — files the developer never touched
+    mb = subprocess.run(["git", "merge-base", base, "HEAD"],
+                        cwd=root, capture_output=True, text=True)
+    diff_base = mb.stdout.strip() if mb.returncode == 0 else base
+    # quotepath=off: with the default core.quotepath, a non-ASCII filename
+    # comes back octal-escaped in quotes and fails the .py filter — the
+    # developer's change silently drops out (a false-green lane)
+    diff = subprocess.run(
+        ["git", "-c", "core.quotepath=off", "diff", "--name-only",
+         "--diff-filter=d", diff_base],
+        cwd=root, capture_output=True, text=True)
+    if diff.returncode != 0:
+        raise ValueError(f"git diff vs '{base}' failed: "
+                         f"{diff.stderr.strip() or diff.stdout.strip()}")
+    untracked = subprocess.run(
+        ["git", "-c", "core.quotepath=off", "ls-files", "--others",
+         "--exclude-standard"],
+        cwd=root, capture_output=True, text=True)
+    if untracked.returncode != 0:
+        # an empty untracked set from a failed query would silently drop new
+        # files from the lint set — the same false-green class as above
+        raise ValueError(f"git ls-files failed: "
+                         f"{untracked.stderr.strip() or untracked.stdout.strip()}")
+    abs_root = os.path.abspath(root)
+    scan_roots = tuple(os.path.join(abs_root, d) + os.sep
+                       for d in DEFAULT_SCAN_DIRS
+                       if os.path.isdir(os.path.join(abs_root, d)))
+    if not scan_roots:  # no package layout under root: everything under root
+        scan_roots = (abs_root + os.sep, )
+    names = [os.path.join(toplevel, n) for n in diff.stdout.splitlines()]
+    # ls-files paths are cwd-relative (= root, the subprocess cwd)
+    names += [os.path.join(root, n) for n in untracked.stdout.splitlines()]
+    out: Set[str] = set()
+    for path in names:
+        if not path.endswith(".py"):
+            continue
+        path = os.path.abspath(path)
+        if path.startswith(scan_roots) and os.path.isfile(path):
+            out.add(path)
+    return sorted(out)
+
+
 def _relpath(path: str, root: str) -> str:
     try:
         rel = os.path.relpath(path, root)
@@ -93,7 +162,7 @@ def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
                  extra_declared_keys: Iterable[str] = (),
                  report_unused_suppressions: bool = True,
                  context_modules: Optional[List[ModuleInfo]] = None,
-                 api_surface=None,
+                 api_surface=None, mesh_manifest=None,
                  _stats: Optional[Dict[str, int]] = None) -> List[Finding]:
     """Findings come only from ``modules``; ``context_modules`` (a superset,
     default = modules) feeds ProjectContext so a subset lint still sees the
@@ -101,7 +170,7 @@ def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
     rules = rules if rules is not None else build_rules()
     ctx = ProjectContext(context_modules or modules,
                          extra_declared_keys=extra_declared_keys,
-                         api_surface=api_surface)
+                         api_surface=api_surface, mesh_manifest=mesh_manifest)
     findings: List[Finding] = []
     suppressed = 0
     for mod in modules:
@@ -132,7 +201,7 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
              rules: Optional[List[Rule]] = None,
              baseline: Optional[Dict[str, int]] = None,
              report_unused_suppressions: bool = True,
-             api_surface=_UNSET) -> LintResult:
+             api_surface=_UNSET, mesh_manifest=_UNSET) -> LintResult:
     t0 = time.perf_counter()
     root = root or os.getcwd()
     files = iter_python_files(paths)
@@ -142,20 +211,30 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
         # default: the committed manifest at the repo root (None = never
         # generated, which jax-api-surface reports as its own finding)
         api_surface = load_api_surface(os.path.join(root, DEFAULT_MANIFEST_NAME))
+    if mesh_manifest is _UNSET:
+        # same contract for the mesh manifest (unknown-mesh-axis owns it)
+        mesh_manifest = load_mesh_manifest(
+            os.path.join(root, DEFAULT_MESH_MANIFEST_NAME))
     # linting a SUBSET still needs whole-package context (ConfigModel schemas,
     # the DECLARED_EXTRA_KEYS registry) or declared-key checks mass-misfire
     context_modules = modules
     pkg_root = os.path.join(root, "deepspeed_tpu")
     if os.path.isdir(pkg_root):
-        have = {m.path for m in modules}
-        extra_files = [f for f in iter_python_files([pkg_root]) if f not in have]
+        # compare normalized: a linted file given as a RELATIVE path must not
+        # re-enter as a context duplicate — the duplicate's parse tree would
+        # shadow the linted module's per-relpath facts (mesh model, jit roots),
+        # and any id()-keyed node lookup on them silently stops matching
+        have = {os.path.abspath(m.path) for m in modules}
+        extra_files = [f for f in iter_python_files([pkg_root])
+                       if os.path.abspath(f) not in have]
         if extra_files:
             extra_modules, _ = load_modules(extra_files, root)
             context_modules = modules + extra_modules
     stats: Dict[str, int] = {}
     all_findings = errors + lint_modules(
         modules, rules, report_unused_suppressions=report_unused_suppressions,
-        context_modules=context_modules, api_surface=api_surface, _stats=stats)
+        context_modules=context_modules, api_surface=api_surface,
+        mesh_manifest=mesh_manifest, _stats=stats)
     active, baselined = apply_baseline(all_findings, baseline or {})
     checked = sorted({m.relpath for m in modules} | {e.path for e in errors})
     return LintResult(findings=active, baselined=baselined,
@@ -171,14 +250,15 @@ def lint_source(source: str, filename: str = "snippet.py",
                 extra_declared_keys: Iterable[str] = (),
                 report_unused_suppressions: bool = False,
                 context_sources: Optional[Dict[str, str]] = None,
-                api_surface=None) -> List[Finding]:
+                api_surface=None, mesh_manifest=None) -> List[Finding]:
     """Test/fixture helper: lint one source string in isolation.
 
     ``context_sources`` ({filename: source}) joins the ProjectContext without
     being linted — e.g. a fake ``deepspeed_tpu/compat/__init__.py`` carrying a
-    SHIMMED_SYMBOLS registry for direct-shimmed-import fixtures.
-    ``api_surface`` is the pinned-symbol set for jax-api-surface fixtures
-    (None = manifest never generated)."""
+    SHIMMED_SYMBOLS registry for direct-shimmed-import fixtures, or a fake
+    ``deepspeed_tpu/parallel/mesh.py`` declaring axis constants for the mesh
+    rules.  ``api_surface`` / ``mesh_manifest`` are the pinned sets for the
+    two manifest rules (None = manifest never generated)."""
     try:
         tree = ast.parse(source, filename=filename)
     except SyntaxError as exc:
@@ -194,4 +274,5 @@ def lint_source(source: str, filename: str = "snippet.py",
     rules = build_rules(rule_names) if rule_names is not None else build_rules()
     return lint_modules([mod], rules, extra_declared_keys=extra_declared_keys,
                         report_unused_suppressions=report_unused_suppressions,
-                        context_modules=context, api_surface=api_surface)
+                        context_modules=context, api_surface=api_surface,
+                        mesh_manifest=mesh_manifest)

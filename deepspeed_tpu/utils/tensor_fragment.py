@@ -48,7 +48,7 @@ def _gather_full(leaf) -> np.ndarray:
     if isinstance(leaf, jax.Array) and len(leaf.sharding.device_set) > 1:
         rep = NamedSharding(leaf.sharding.mesh, PartitionSpec())
         leaf = jax.device_put(leaf, rep)
-    return np.asarray(leaf)
+    return np.asarray(leaf)  # dslint: disable=sharding-dropped-at-boundary  # deliberate collapse: the debug/API contract of safe_get_* is a full host ndarray — replicate-then-fetch is the point
 
 
 def safe_get_full_fp32_param(engine, param_path: str) -> Optional[np.ndarray]:

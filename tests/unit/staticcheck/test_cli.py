@@ -120,5 +120,265 @@ def test_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in ("host-sync-in-hot-path", "traced-control-flow", "donation-after-use",
                  "nondeterministic-rng", "silent-except", "float64-in-compute",
-                 "undeclared-config-key", "bad-suppression", "unused-suppression"):
+                 "undeclared-config-key", "bad-suppression", "unused-suppression",
+                 "unknown-mesh-axis", "sharding-dropped-at-boundary",
+                 "spec-rank-mismatch", "recompile-risk",
+                 "donation-sharding-mismatch"):
         assert rule in out
+
+
+# ---------------------------------------------------------------- SARIF
+def test_sarif_format_round_trips(tree, capsys):
+    """SARIF output parses, carries every active finding with its location
+    and fingerprint, and maps severities to SARIF levels — what a CI
+    annotator needs to render findings inline."""
+    rc, out = run_cli([str(tree / "pkg"), "--root", str(tree),
+                       "--format", "sarif"], capsys)
+    assert rc == 1
+    sarif = json.loads(out)
+    assert sarif["version"] == "2.1.0"
+    (run, ) = sarif["runs"]
+    assert run["tool"]["driver"]["name"] == "dslint"
+    (res, ) = run["results"]
+    assert res["ruleId"] == "silent-except"
+    assert res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/dirty.py"
+    assert loc["region"]["startLine"] == 5
+    assert res["partialFingerprints"]["dslintFingerprint/v1"]
+    # the rule catalog rides along and the result indexes into it
+    rules = run["tool"]["driver"]["rules"]
+    assert rules[res["ruleIndex"]]["id"] == "silent-except"
+    # compare against the JSON reporter: same findings, same fingerprints
+    rc, jout = run_cli([str(tree / "pkg"), "--root", str(tree),
+                        "--format", "json"], capsys)
+    jdata = json.loads(jout)
+    assert [r["partialFingerprints"]["dslintFingerprint/v1"]
+            for r in run["results"]] == \
+        [f["fingerprint"] for f in jdata["findings"]]
+
+
+def test_sarif_clean_tree_has_empty_results(tree, capsys):
+    rc, out = run_cli([str(tree / "pkg" / "clean.py"), "--root", str(tree),
+                       "--format", "sarif"], capsys)
+    assert rc == 0
+    assert json.loads(out)["runs"][0]["results"] == []
+
+
+# -------------------------------------------------------------- --changed
+def _git(tree, *args):
+    import subprocess
+    subprocess.run(["git", *args], cwd=str(tree), check=True,
+                   capture_output=True,
+                   env={**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_mode_lints_only_files_changed_vs_base(tree, capsys):
+    _git(tree, "init", "-q")
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "seed")
+    # clean working tree: nothing to lint, exit 0 even though dirty.py has a
+    # (committed) finding
+    rc, out = run_cli(["--root", str(tree), "--changed"], capsys)
+    assert rc == 0 and "no python files changed" in out
+    # touch ONLY the clean file: still exits 0 (dirty.py is out of scope)
+    (tree / "pkg" / "clean.py").write_text(CLEAN + "\n# edited\n")
+    rc, out = run_cli(["--root", str(tree), "--changed"], capsys)
+    assert rc == 0 and "1 files" in out
+    # a new (untracked) dirty file is in scope
+    (tree / "pkg" / "fresh.py").write_text(DIRTY.replace("def f", "def fresh"))
+    rc, out = run_cli(["--root", str(tree), "--changed"], capsys)
+    assert rc == 1 and "fresh.py" in out and "dirty.py" not in out
+    # an explicit git base works too: vs HEAD~0 (== HEAD) same result
+    rc, out = run_cli(["--root", str(tree), "--changed", "HEAD"], capsys)
+    assert rc == 1 and "fresh.py" in out
+
+
+def test_changed_mode_refuses_explicit_paths_and_bad_base(tree, capsys):
+    assert main([str(tree / "pkg"), "--root", str(tree), "--changed"]) == 2
+    _git(tree, "init", "-q")
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "seed")
+    assert main(["--root", str(tree), "--changed", "no-such-ref"]) == 2
+
+
+# ------------------------------------------------------- mesh manifest CLI
+def test_update_mesh_manifest_and_refusals(tmp_path, capsys):
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "mesh.py").write_text(textwrap.dedent("""
+        from jax.sharding import Mesh
+        DATA_AXIS = "data"
+
+        def build(devs):
+            return Mesh(devs, axis_names=("data", "model"))
+        """))
+    rc, out = run_cli(["--root", str(tmp_path), "--update-mesh-manifest"], capsys)
+    assert rc == 0 and "2 axis name(s)" in out
+    data = json.loads((tmp_path / ".dslint-mesh-manifest.json").read_text())
+    assert data == {"version": 1, "axes": ["data", "model"]}
+    # same hardening as the other two manifests: no partial-view re-pins
+    assert main(["--root", str(tmp_path), "--update-mesh-manifest",
+                 "--select", "unknown-mesh-axis"]) == 2
+    assert main(["--root", str(tmp_path), "--update-mesh-manifest",
+                 "--disable", "silent-except"]) == 2
+    # unparseable package refuses the update
+    (pkg / "broken.py").write_text("def broken(:\n")
+    assert main(["--root", str(tmp_path), "--update-mesh-manifest"]) == 2
+
+
+def test_lint_against_regenerated_mesh_manifest_is_clean(tmp_path, capsys):
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "mesh.py").write_text(textwrap.dedent("""
+        from jax.sharding import Mesh, PartitionSpec
+        DATA_AXIS = "data"
+
+        SPEC = PartitionSpec(DATA_AXIS)
+
+        def build(devs):
+            return Mesh(devs, axis_names=("data", ))
+        """))
+    run_cli(["--root", str(tmp_path), "--update-mesh-manifest"], capsys)
+    run_cli(["--root", str(tmp_path), "--update-api-surface"], capsys)
+    rc, out = run_cli([str(pkg), "--root", str(tmp_path)], capsys)
+    assert rc == 0, out
+    # now introduce the typo class: a spec axis no mesh declares
+    (pkg / "user.py").write_text(textwrap.dedent("""
+        from jax.sharding import PartitionSpec
+        SPEC = PartitionSpec("dataa")
+        """))
+    rc, out = run_cli([str(pkg), "--root", str(tmp_path)], capsys)
+    assert rc == 1 and "unknown-mesh-axis" in out and "'dataa'" in out
+
+
+def test_relative_path_subset_lint_is_not_shadowed_by_context(tmp_path, capsys,
+                                                              monkeypatch):
+    """A linted file given as a RELATIVE path must not re-enter as a
+    whole-package context duplicate: the duplicate's parse tree would shadow
+    the linted module's per-relpath facts (mesh model spec sites, jit roots)
+    and every id()-keyed node lookup on them would silently stop matching —
+    spec-rank-mismatch missed real findings exactly this way."""
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        def build(mesh):
+            spec = PartitionSpec("data", None, None)
+            x = jnp.zeros((4, 8))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        def mk(devs):
+            return Mesh(devs, axis_names=("data", ))
+        """))
+    run_cli(["--root", str(tmp_path), "--update-mesh-manifest"], capsys)
+    run_cli(["--root", str(tmp_path), "--update-api-surface"], capsys)
+    monkeypatch.chdir(tmp_path)
+    rc, out = run_cli(["deepspeed_tpu/bad.py", "--root", str(tmp_path)], capsys)
+    assert rc == 1 and "spec-rank-mismatch" in out, out
+    # and identical to the absolute-path run
+    rc_abs, out_abs = run_cli([str(pkg / "bad.py"), "--root", str(tmp_path)],
+                              capsys)
+    assert rc_abs == 1 and "spec-rank-mismatch" in out_abs
+
+
+def test_changed_mode_monorepo_subroot_and_scan_root_scoping(tmp_path, capsys):
+    """Two --changed contracts at once: `git diff --name-only` prints paths
+    relative to the git TOPLEVEL (not --root), so a package living in a
+    monorepo subdir must still see its committed changes; and changed files
+    OUTSIDE the default scan roots (bench/scripts) stay out of the set —
+    the full `make lint` never lints them, so lint-changed must not fail on
+    findings the full run would never report."""
+    root = tmp_path / "sub"
+    pkg = root / "deepspeed_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(CLEAN)
+    (root / "bench.py").write_text(CLEAN)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # dirty BOTH vs HEAD: only the package file may enter the lint set
+    (pkg / "mod.py").write_text(DIRTY)
+    (root / "bench.py").write_text(DIRTY.replace("def f", "def bench"))
+    rc, out = run_cli(["--root", str(root), "--changed", "HEAD"], capsys)
+    assert rc == 1, out
+    assert "mod.py" in out and "silent-except" in out
+    assert "bench.py" not in out
+
+
+def test_changed_mode_diffs_against_merge_base(tmp_path, capsys):
+    """BASE=origin/main on a branch that is BEHIND upstream: files changed
+    only upstream must not enter the changed set — the lane lints what the
+    developer touched, not upstream drift."""
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "mine.py").write_text(CLEAN)
+    (pkg / "upstream.py").write_text(CLEAN)
+    _git(tmp_path, "init", "-q", "-b", "main")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _git(tmp_path, "checkout", "-q", "-b", "feature")
+    # upstream moves on without us (a finding lands in upstream.py on main)
+    _git(tmp_path, "checkout", "-q", "main")
+    (pkg / "upstream.py").write_text(DIRTY)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "upstream drift")
+    _git(tmp_path, "checkout", "-q", "feature")
+    # the developer's own change is clean
+    (pkg / "mine.py").write_text(CLEAN + "\n# edited\n")
+    run_cli(["--root", str(tmp_path), "--update-api-surface"], capsys)
+    rc, out = run_cli(["--root", str(tmp_path), "--changed", "main"], capsys)
+    assert rc == 0, out
+    assert "1 files" in out and "upstream.py" not in out
+
+
+def test_changed_mode_refuses_update_modes(tree, capsys):
+    for flag in ("--update-baseline", "--update-api-surface",
+                 "--update-mesh-manifest"):
+        assert main(["--root", str(tree), "--changed", flag]) == 2
+
+
+def test_changed_mode_empty_set_emits_valid_json_and_sarif(tree, capsys):
+    """A CI consumer piping --format json/sarif must get a valid EMPTY
+    document on a no-change run, not a prose line (or a traceback)."""
+    _git(tree, "init", "-q")
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "seed")
+    rc, out = run_cli(["--root", str(tree), "--changed", "--format", "json"],
+                      capsys)
+    assert rc == 0
+    data = json.loads(out)
+    assert data["findings"] == [] and data["summary"]["files_checked"] == 0
+    rc, out = run_cli(["--root", str(tree), "--changed", "--format", "sarif"],
+                      capsys)
+    assert rc == 0
+    sarif = json.loads(out)
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"] == []
+
+
+def test_changed_mode_surfaces_ls_files_failure(tree, capsys, monkeypatch):
+    """A failed `git ls-files` (stale index.lock, corrupt index) must be a
+    usage error, not an empty untracked set — new files silently dropping
+    out of the lint set is the false-green class --changed hardens against."""
+    import subprocess as sp
+    _git(tree, "init", "-q")
+    _git(tree, "add", "-A")
+    _git(tree, "commit", "-qm", "seed")
+    real_run = sp.run
+
+    def failing_ls_files(cmd, **kwargs):
+        if "ls-files" in cmd:
+            return sp.CompletedProcess(cmd, 128, stdout="",
+                                       stderr="fatal: index file corrupt")
+        return real_run(cmd, **kwargs)
+
+    monkeypatch.setattr(sp, "run", failing_ls_files)
+    rc = main(["--root", str(tree), "--changed"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "ls-files" in err and "index file corrupt" in err

@@ -1,0 +1,893 @@
+"""Mesh/sharding rules (ISSUE 14): per-rule positive and negative fixtures,
+mesh-model extraction (cross-module axis aliasing, multi-mesh files), and the
+mesh-manifest contracts.
+
+Every positive fixture is seeded from a real finding or a distilled real bug:
+
+- ``unknown-mesh-axis`` — the PR 9 GSPMD kv-projection miscompile class (an
+  axis-name typo in a PartitionSpec silently changes the partitioning);
+- ``sharding-dropped-at-boundary`` — the two in-tree gather-to-host sites the
+  rule caught on landing (checkpointing/tensor_fragment, suppressed with
+  reasons) plus the DeviceBatchState commit path distilled (sharded slot
+  buffers rebuilt through un-annotated uploads);
+- ``spec-rank-mismatch`` — an over-ranked kv-pool spec (tp.py's
+  ``[L, NB, KV, bs, Dh]`` pool specs are exactly this shape of hazard);
+- ``recompile-risk`` — fastpath.feed's ``np.empty((m_pad, 2))`` upload with
+  the bucketing removed (the zero-warm-recompiles invariant);
+- ``donation-sharding-mismatch`` — engine_v2's donated kv pool rebound with a
+  different spec (the aliasing contract of ``donate_argnums=(1,)``).
+
+Fixture files use ``deepspeed_tpu/`` paths: mesh declarations only count from
+package files (tests construct ad-hoc meshes freely and are not scanned by
+the mesh rules).
+"""
+
+import textwrap
+
+from deepspeed_tpu.tools.staticcheck import lint_source
+from deepspeed_tpu.tools.staticcheck.mesh_model import (
+    MeshModel, creation_rank, load_mesh_manifest, save_mesh_manifest)
+from deepspeed_tpu.tools.staticcheck.runner import load_modules
+
+AXES = {"data", "tensor"}
+
+# fake canonical axis-constant module (parallel/mesh.py convention)
+MESH_CTX = {
+    "deepspeed_tpu/parallel/mesh.py": textwrap.dedent("""
+        DATA_AXIS = "data"
+        TENSOR_AXIS = "tensor"
+        """),
+}
+
+
+def run(src, rules, filename="deepspeed_tpu/mod.py", mesh_manifest=frozenset(AXES),
+        context_sources=MESH_CTX, **kw):
+    return lint_source(textwrap.dedent(src), filename=filename, rule_names=rules,
+                       mesh_manifest=set(mesh_manifest) if mesh_manifest is not None
+                       else None,
+                       context_sources=context_sources, **kw)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------- unknown-mesh-axis
+class TestUnknownMeshAxis:
+    RULE = ["unknown-mesh-axis"]
+
+    def test_flags_axis_typo_the_pr9_miscompile_class(self):
+        # distilled PR 9: the kv-projection spec with the axis name typo'd —
+        # GSPMD accepts it and silently partitions differently
+        out = run("""
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def kv_spec(mesh):
+                return NamedSharding(mesh, PartitionSpec(None, None, "tensro"))
+            """, self.RULE)
+        assert rules_of(out) == ["unknown-mesh-axis"]
+        assert "'tensro'" in out[0].message and "miscompile" in out[0].message
+
+    def test_known_literals_and_empty_spec_pass(self):
+        out = run("""
+            from jax.sharding import PartitionSpec
+
+            SPECS = (PartitionSpec("data", None), PartitionSpec(),
+                     PartitionSpec(("data", "tensor")))
+            """, self.RULE)
+        assert out == []
+
+    def test_axis_constant_resolves_across_modules(self):
+        out = run("""
+            from ..parallel.mesh import TENSOR_AXIS
+            from jax.sharding import PartitionSpec
+
+            SPEC = PartitionSpec(None, TENSOR_AXIS)
+            """, self.RULE)
+        assert out == []
+
+    def test_aliased_import_of_axis_constant_resolves(self):
+        out = run("""
+            from ..parallel.mesh import TENSOR_AXIS as TP
+            from jax.sharding import PartitionSpec
+
+            SPEC = PartitionSpec(TP)
+            """, self.RULE)
+        assert out == []
+
+    def test_unresolvable_name_is_skipped_not_flagged(self):
+        out = run("""
+            from jax.sharding import PartitionSpec
+
+            def spec_for(axis):
+                return PartitionSpec(axis)
+            """, self.RULE)
+        assert out == []
+
+    def test_in_specs_and_axis_names_are_checked(self):
+        out = run("""
+            from jax.sharding import PartitionSpec
+            from ..compat import shard_map
+
+            def build(fn, mesh):
+                return shard_map(fn, mesh=mesh,
+                                 in_specs=(PartitionSpec("bogus"), ),
+                                 out_specs=PartitionSpec(),
+                                 axis_names={"ghost"})
+            """, self.RULE)
+        assert sorted(f.message.split("'")[1] for f in out) == ["bogus", "ghost"]
+
+    def test_missing_manifest_is_one_actionable_finding(self):
+        out = run("""
+            from jax.sharding import PartitionSpec
+            SPEC = PartitionSpec("data")
+            """, self.RULE, mesh_manifest=None)
+        assert rules_of(out) == ["unknown-mesh-axis"]
+        assert "--update-mesh-manifest" in out[0].message
+
+    def test_declared_but_unpinned_axis_demands_regen(self):
+        out = run("""
+            from jax.sharding import Mesh, PartitionSpec
+            import numpy as np
+
+            def build(devices):
+                return Mesh(np.array(devices), axis_names=("data", "model"))
+            """, self.RULE, mesh_manifest={"data"})
+        assert rules_of(out) == ["unknown-mesh-axis"]
+        assert "model" in out[0].message and "not pinned" in out[0].message
+
+    def test_unpinned_and_stale_manifest_findings_have_distinct_fingerprints(self):
+        # both can co-occur (an axis rename); identical fingerprints would let
+        # one baseline entry / SARIF upload dedup swallow the other
+        out = run("""
+            from jax.sharding import Mesh, PartitionSpec
+
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("renamed", ))
+                return mesh, PartitionSpec("renamed")
+            """, self.RULE, filename="deepspeed_tpu/parallel/custom.py",
+            mesh_manifest={"oldname"})
+        kinds = sorted(f.snippet for f in out
+                       if f.path == ".dslint-mesh-manifest.json")
+        assert kinds == ["mesh-manifest-stale", "mesh-manifest-unpinned"]
+        prints = {f.fingerprint for f in out}
+        assert len(prints) == len(out)
+
+    def test_stale_manifest_axis_is_warned(self):
+        out = run("""
+            from jax.sharding import PartitionSpec
+            SPEC = PartitionSpec("data")
+            """, self.RULE, mesh_manifest={"data", "tensor", "ghost"})
+        assert rules_of(out) == ["unknown-mesh-axis"]
+        assert out[0].severity == "warning" and "ghost" in out[0].message
+
+    def test_manifest_pinned_axis_is_usable_even_if_declared_elsewhere(self):
+        # the manifest is part of the known set: axes pinned there don't
+        # re-fire per USE even when this context can't see the declaring
+        # module — only the manifest-sync staleness warning remains (and in
+        # real runs the runner always supplies whole-package context)
+        out = run("""
+            from jax.sharding import PartitionSpec
+            SPEC = PartitionSpec("tensor")
+            """, self.RULE, context_sources=None)
+        assert [f for f in out if f.path != ".dslint-mesh-manifest.json"] == []
+
+
+# --------------------------------------------- local declarations
+class TestUnknownMeshAxisLocalDeclarations:
+    RULE = ["unknown-mesh-axis"]
+
+    def test_module_local_mesh_validates_its_own_specs(self):
+        # a non-package file (reached e.g. via --changed) building an ad-hoc
+        # mesh: its own declarations count, undeclared axes still flag
+        out = run("""
+            from jax.sharding import Mesh, PartitionSpec
+            LOCAL_AXIS = "local"
+
+            def build(devs):
+                mesh = Mesh(devs, axis_names=("adhoc", ))
+                good = PartitionSpec("adhoc")
+                also_good = PartitionSpec(LOCAL_AXIS)
+                bad = PartitionSpec("adhocc")
+                return mesh, good, also_good, bad
+            """, self.RULE, filename="scripts/adhoc_bench.py")
+        assert rules_of(out) == ["unknown-mesh-axis"]
+        assert "'adhocc'" in out[0].message
+
+
+# --------------------------------------------- sharding-dropped-at-boundary
+class TestShardingDroppedAtBoundary:
+    RULE = ["sharding-dropped-at-boundary"]
+
+    def test_flags_np_asarray_of_placed_value(self):
+        # the in-tree catch distilled: replicate-then-fetch without a reason
+        out = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def gather(leaf, mesh):
+                rep = NamedSharding(mesh, PartitionSpec())
+                leaf = jax.device_put(leaf, rep)
+                return np.asarray(leaf)
+            """, self.RULE)
+        assert rules_of(out) == ["sharding-dropped-at-boundary"]
+        assert "np.asarray" in out[0].message
+
+    def test_flags_device_get_via_sharding_variable(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def fetch(x, mesh):
+                s = NamedSharding(mesh, PartitionSpec("data"))
+                x = jax.device_put(x, s)
+                return jax.device_get(x)
+            """, self.RULE)
+        assert rules_of(out) == ["sharding-dropped-at-boundary"]
+
+    def test_flags_unannotated_reput_collapsing_to_default_device(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def stage(x, mesh):
+                x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("data")))
+                y = jax.device_put(x)
+                return y
+            """, self.RULE)
+        assert rules_of(out) == ["sharding-dropped-at-boundary"]
+        assert "default single device" in out[0].message
+
+    def test_seeded_regression_device_batch_state_commit_path(self):
+        # the multichip DeviceBatchState hazard distilled: slot buffers placed
+        # with NamedSharding at init, then the commit path re-wraps them
+        # through a bare jnp.asarray — silently single-device again
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            class DeviceBatchState:
+                def __init__(self, mesh, n, t):
+                    self.tokens = jax.device_put(
+                        jnp.zeros((n, t), jnp.int32),
+                        NamedSharding(mesh, PartitionSpec("data")))
+
+                def commit(self, packed):
+                    flat = jnp.asarray(self.tokens)
+                    return flat.at[packed[:, 0]].set(packed[:, 1:])
+            """, self.RULE)
+        assert rules_of(out) == ["sharding-dropped-at-boundary"]
+        assert "self.tokens" in out[0].message
+
+    def test_jnp_asarray_with_device_keeps_the_placement(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def commit(x, mesh):
+                s = NamedSharding(mesh, PartitionSpec("data"))
+                x = jax.device_put(x, s)
+                return jnp.asarray(x, device=s)
+            """, self.RULE)
+        assert out == []
+
+    def test_rebinding_from_unknown_call_stops_tracking(self):
+        out = run("""
+            import jax
+            import numpy as np
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def step(x, fwd, mesh):
+                x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("data")))
+                x = fwd(x)
+                return np.asarray(x)
+            """, self.RULE)
+        assert out == []
+
+    def test_unrelated_place_helper_is_not_a_placement(self):
+        # only tp.py's place(topology, tree, specs) arity counts — a grid or
+        # scheduler .place(item) must not mark its result as sharded
+        out = run("""
+            import numpy as np
+
+            def assign(grid, item):
+                pos = grid.place(item)
+                return np.asarray(pos)
+            """, self.RULE)
+        assert out == []
+
+    def test_unplaced_values_never_flag(self):
+        out = run("""
+            import numpy as np
+
+            def host_only(x):
+                return np.asarray(x)
+            """, self.RULE)
+        assert out == []
+
+
+# --------------------------------------------------------- spec-rank-mismatch
+class TestSpecRankMismatch:
+    RULE = ["spec-rank-mismatch"]
+
+    def test_flags_overranked_spec_on_known_rank_array(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(mesh):
+                return jax.device_put(
+                    jnp.zeros((4, 8)),
+                    NamedSharding(mesh, PartitionSpec("data", None, "tensor")))
+            """, self.RULE)
+        assert rules_of(out) == ["spec-rank-mismatch"]
+        assert "3 dimension(s)" in out[0].message and "rank 2" in out[0].message
+
+    def test_flags_through_local_spec_and_value_variables(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(mesh):
+                spec = PartitionSpec("data", None, "tensor")
+                x = jnp.zeros((4, 8))
+                return jax.device_put(x, NamedSharding(mesh, spec))
+            """, self.RULE)
+        assert rules_of(out) == ["spec-rank-mismatch"]
+
+    def test_flags_make_array_from_callback_shape(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def build(mesh, cb):
+                return jax.make_array_from_callback(
+                    (8, ), NamedSharding(mesh, PartitionSpec(None, "tensor")), cb)
+            """, self.RULE)
+        assert rules_of(out) == ["spec-rank-mismatch"]
+
+    def test_flags_through_sharding_variable_chain(self):
+        # the repo's dominant idiom: spec bound to a variable, NamedSharding
+        # bound to another, device_put through the second — collection must
+        # run in source order for the chain to resolve
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(mesh):
+                spec = PartitionSpec("data", None, None)
+                sh = NamedSharding(mesh, spec)
+                return jax.device_put(jnp.zeros((4, 8)), sh)
+            """, self.RULE)
+        assert rules_of(out) == ["spec-rank-mismatch"]
+
+    def test_equal_or_shorter_spec_is_legal_replication(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(mesh):
+                a = jax.device_put(jnp.zeros((4, 8)),
+                                   NamedSharding(mesh, PartitionSpec("data", "tensor")))
+                b = jax.device_put(jnp.zeros((4, 8)),
+                                   NamedSharding(mesh, PartitionSpec("data")))
+                c = jax.device_put(jnp.zeros((4, 8)),
+                                   NamedSharding(mesh, PartitionSpec()))
+                return a, b, c
+            """, self.RULE)
+        assert out == []
+
+    def test_rebind_to_unknown_rank_invalidates_the_name(self):
+        # a rebind to an unknown-rank value must clear the "provable" rank —
+        # a stale entry would make the lint exit 1 on correct code
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def f(mesh, load):
+                x = jnp.zeros((4, 8))
+                x = load()
+                return jax.device_put(x, NamedSharding(mesh, PartitionSpec("data", None, "tensor")))
+            """, self.RULE)
+        assert out == []
+
+    def test_rebind_after_the_call_does_not_backdate(self):
+        out = run("""
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def f(mesh, params):
+                y = jax.device_put(params, NamedSharding(mesh, PartitionSpec("data", None, "tensor")))
+                params = jnp.zeros((4, ))
+                return y, params
+            """, self.RULE)
+        assert out == []
+
+    def test_unknown_rank_or_splat_spec_is_skipped(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def place(mesh, x, dims):
+                a = jax.device_put(x, NamedSharding(mesh, PartitionSpec("data", "tensor")))
+                b = jax.device_put(x, NamedSharding(mesh, PartitionSpec(*dims)))
+                return a, b
+            """, self.RULE)
+        assert out == []
+
+
+# ------------------------------------------------------------ recompile-risk
+class TestRecompileRisk:
+    RULE = ["recompile-risk"]
+    V2 = "deepspeed_tpu/inference/v2/mod.py"
+
+    def test_flags_raw_cardinality_in_static_position(self):
+        out = run("""
+            import jax
+
+            class Engine:
+                def build(self, f):
+                    self.fwd = jax.jit(f, static_argnums=(1, ))
+
+                def step(self, x):
+                    return self.fwd(x, len(self.manager.seqs))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+        assert "static position 1" in out[0].message
+
+    def test_flags_static_argnames_keyword(self):
+        out = run("""
+            import jax
+
+            def build(f, rows):
+                fwd = jax.jit(f, static_argnames=("n", ))
+                return fwd(0, n=len(rows))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+        assert "'n'" in out[0].message
+
+    def test_bucketed_static_args_pass(self):
+        out = run("""
+            import jax
+            from .fastpath import round_up_pow2
+
+            class Engine:
+                def build(self, f):
+                    self.fwd = jax.jit(f, static_argnums=(1, ))
+
+                def step(self, x):
+                    a = self.fwd(x, round_up_pow2(len(self.manager.seqs)))
+                    b = self.fwd(x, self._bucket(len(self.manager.seqs)))
+                    c = self.fwd(x, self.block_size)
+                    return a, b, c
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+    def test_seeded_regression_fastpath_feed_without_bucketing(self):
+        # fastpath.feed with the round_up_pow2 padding removed: the upload
+        # shape now tracks the raw pair count, so every distinct count that
+        # reaches the jitted scatter is a fresh compile
+        out = run("""
+            import numpy as np
+
+            class DeviceBatchState:
+                def feed(self, toks_prev, pairs):
+                    arr = np.empty((len(pairs), 2), np.int32)
+                    arr[:] = pairs
+                    return arr
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+        assert "len(pairs)" in out[0].message
+
+    def test_bucketed_shape_construction_passes(self):
+        out = run("""
+            import numpy as np
+            from .fastpath import round_up_pow2
+
+            def feed(pairs):
+                m_pad = round_up_pow2(len(pairs))
+                a = np.empty((m_pad, 2), np.int32)
+                b = np.empty((round_up_pow2(len(pairs)), 2), np.int32)
+                return a, b
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+    def test_rule_is_scoped_to_inference_v2(self):
+        out = run("""
+            import numpy as np
+
+            def host_table(rows):
+                return np.zeros((len(rows), 4))
+            """, self.RULE, filename="deepspeed_tpu/runtime/engine.py")
+        assert out == []
+
+    def test_flags_decorated_method_static_argnames(self):
+        # @partial(jax.jit, static_argnames=...) on a method — the decorator
+        # form collect_jit_roots already models; bound calls are self.<name>
+        out = run("""
+            import jax
+            from functools import partial
+
+            class Engine:
+                @partial(jax.jit, static_argnames=("width", ))
+                def fwd(self, x, width):
+                    return x
+
+                def step(self, x):
+                    return self.fwd(x, width=len(self.manager.seqs))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+        assert "'width'" in out[0].message
+
+    def test_flags_decorated_function_static_argnums_positional(self):
+        out = run("""
+            import jax
+
+            @jax.jit(static_argnums=(1, ))
+            def fwd(x, n):
+                return x
+
+            def step(x, reqs):
+                return fwd(x, len(reqs))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+
+    def test_decorated_method_positional_accounts_for_self(self):
+        # static_argnums counts the UNBOUND signature (self = 0); the bound
+        # call self.fwd(x, n) carries position 2 at call.args[1]
+        out = run("""
+            import jax
+            from functools import partial
+
+            class Engine:
+                @partial(jax.jit, static_argnums=(2, ))
+                def fwd(self, x, n):
+                    return x
+
+                def step(self, x):
+                    return self.fwd(x, len(self.manager.seqs))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+
+    def test_bucketing_the_result_does_not_bless_the_static_arg(self):
+        # round_up_pow2 wrapping the RESULT of the jitted call must not
+        # sanctify the raw cardinality INSIDE its static position
+        out = run("""
+            import jax
+            from .fastpath import round_up_pow2
+
+            def build(f, reqs):
+                fwd = jax.jit(f, static_argnums=(0, ))
+                return round_up_pow2(fwd(len(reqs)))
+            """, self.RULE, filename=self.V2)
+        assert rules_of(out) == ["recompile-risk"]
+
+    def test_decorated_bucketed_call_passes(self):
+        out = run("""
+            import jax
+            from functools import partial
+            from .fastpath import round_up_pow2
+
+            class Engine:
+                @partial(jax.jit, static_argnames=("width", ))
+                def fwd(self, x, width):
+                    return x
+
+                def step(self, x):
+                    return self.fwd(x, width=round_up_pow2(len(self.manager.seqs)))
+            """, self.RULE, filename=self.V2)
+        assert out == []
+
+
+# ------------------------------------------------ static-jit-site extraction
+class TestStaticJitSiteExtraction:
+    def test_decorated_def_is_recorded_exactly_once(self):
+        # the decorator Call also matches the plain-Call branch — it must not
+        # produce a second site with an opaque binding
+        import textwrap as _tw
+        from deepspeed_tpu.tools.staticcheck.context import (
+            annotate_parents, collect_static_jit_sites)
+        mods, errors = load_modules_from_sources({
+            "deepspeed_tpu/inference/v2/m.py": _tw.dedent("""
+                import jax
+
+                @jax.jit(static_argnums=(1, ))
+                def f(x, n):
+                    return x
+                """)})
+        assert not errors
+        annotate_parents(mods[0].tree)
+        sites = collect_static_jit_sites(mods[0])
+        assert [(s.binding, s.name) for s in sites] == [("decorated", "f")]
+
+
+def load_modules_from_sources(sources):
+    import ast as _ast
+    from deepspeed_tpu.tools.staticcheck.context import ModuleInfo
+    mods = []
+    for relpath, src_text in sources.items():
+        tree = _ast.parse(src_text, filename=relpath)
+        mods.append(ModuleInfo(path=relpath, relpath=relpath, source=src_text,
+                               tree=tree, lines=src_text.splitlines()))
+    return mods, []
+
+
+# ---------------------------------------------- donation-sharding-mismatch
+class TestDonationShardingMismatch:
+    RULE = ["donation-sharding-mismatch"]
+
+    def test_flags_respec_of_donated_local(self):
+        # engine_v2's donated kv pool distilled: donate_argnums aliasing only
+        # holds while the bound value keeps its placement spec
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def serve(kv0, f, mesh):
+                step = jax.jit(f, donate_argnums=(0, ))
+                kv = jax.device_put(kv0, NamedSharding(mesh, PartitionSpec(None, None, "tensor")))
+                kv = step(kv)
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec()))
+                kv = step(kv)
+                return kv
+            """, self.RULE)
+        assert rules_of(out) == ["donation-sharding-mismatch"]
+        assert "degrades to a full copy" in out[0].message
+
+    def test_trailing_replicated_dims_are_the_same_spec(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def serve(kv0, f, mesh):
+                step = jax.jit(f, donate_argnums=(0, ))
+                kv = jax.device_put(kv0, NamedSharding(mesh, PartitionSpec("tensor")))
+                kv = step(kv)
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec("tensor", None)))
+                kv = step(kv)
+                return kv
+            """, self.RULE)
+        assert out == []
+
+    def test_axis_constant_and_literal_are_the_same_spec(self):
+        out = run("""
+            import jax
+            from ..parallel.mesh import TENSOR_AXIS
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def serve(kv0, f, mesh):
+                step = jax.jit(f, donate_argnums=(0, ))
+                kv = jax.device_put(kv0, NamedSharding(mesh, PartitionSpec(TENSOR_AXIS)))
+                kv = step(kv)
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec("tensor")))
+                kv = step(kv)
+                return kv
+            """, self.RULE)
+        assert out == []
+
+    def test_flags_cross_method_attribute_respec(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            class Engine:
+                def __init__(self, kv, f, mesh):
+                    self.mesh = mesh
+                    self._step = jax.jit(f, donate_argnums=(0, ))
+                    self.kv = jax.device_put(
+                        kv, NamedSharding(mesh, PartitionSpec(None, "tensor")))
+
+                def resize(self, kv):
+                    self.kv = jax.device_put(
+                        kv, NamedSharding(self.mesh, PartitionSpec()))
+
+                def step(self):
+                    self.kv = self._step(self.kv)
+            """, self.RULE)
+        assert rules_of(out) == ["donation-sharding-mismatch"]
+        assert "self.kv" in out[0].message
+
+    def test_finding_anchors_on_the_rebind_not_the_placement(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def serve(f, mesh, kv, x):
+                fwd = jax.jit(f, donate_argnums=(0, ))
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec("tensor")))
+                out, kv = fwd(kv, x)
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec()))
+                return out
+            """, self.RULE)
+        assert rules_of(out) == ["donation-sharding-mismatch"]
+        # anchored on the REBIND (the later device_put), citing the original
+        assert "PartitionSpec()" in out[0].snippet
+        assert "line 7" in out[0].message
+
+    def test_spec_via_variable_is_skipped_not_guessed(self):
+        # same spec spelled two ways: a literal site and a NamedSharding over
+        # a spec VARIABLE — textual identity can't prove a mismatch, so the
+        # unresolvable form is skipped entirely
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def serve(f, mesh, kv, x):
+                fwd = jax.jit(f, donate_argnums=(0, ))
+                spec = PartitionSpec("data")
+                kv = jax.device_put(kv, NamedSharding(mesh, PartitionSpec("data")))
+                out, kv = fwd(kv, x)
+                kv = jax.device_put(kv, NamedSharding(mesh, spec))
+                return out
+            """, self.RULE)
+        assert out == []
+
+    def test_undonated_values_may_respec_freely(self):
+        out = run("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def stage(x, mesh):
+                x = jax.device_put(x, NamedSharding(mesh, PartitionSpec("tensor")))
+                x = jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+                return x
+            """, self.RULE)
+        assert out == []
+
+
+# ------------------------------------------------------ mesh-model extraction
+class TestMeshModelExtraction:
+    def _model(self, sources):
+        modules, errors = [], []
+        import ast
+        from deepspeed_tpu.tools.staticcheck.context import ModuleInfo
+        for name, src in sources.items():
+            src = textwrap.dedent(src)
+            modules.append(ModuleInfo(path=name, relpath=name, source=src,
+                                      tree=ast.parse(src),
+                                      lines=src.splitlines()))
+        assert not errors
+        return MeshModel(modules), modules
+
+    def test_axis_constants_and_mesh_ctors_declare(self):
+        model, _ = self._model({
+            "deepspeed_tpu/parallel/mesh.py": """
+                DATA_AXIS = "data"
+                TENSOR_AXIS = "tensor"
+                """,
+            "deepspeed_tpu/comm/groups.py": """
+                from jax.sharding import Mesh
+                import numpy as np
+
+                def build(devices):
+                    return Mesh(np.array(devices), axis_names=("pipe", "expert"))
+                """,
+        })
+        assert model.declared_axis_names() == {"data", "tensor", "pipe", "expert"}
+
+    def test_make_mesh_positional_names_declare(self):
+        model, _ = self._model({
+            "deepspeed_tpu/x.py": """
+                import jax
+
+                def build():
+                    return jax.make_mesh((2, 4), ("dp", "tp"))
+                """,
+        })
+        assert model.declared_axis_names() == {"dp", "tp"}
+
+    def test_multi_mesh_file_declares_every_mesh(self):
+        model, mods = self._model({
+            "deepspeed_tpu/x.py": """
+                from jax.sharding import Mesh
+
+                def serving(devs):
+                    return Mesh(devs, axis_names=("data", ))
+
+                def training(devs):
+                    return Mesh(devs, axis_names=("data", "fsdp"))
+                """,
+        })
+        assert model.declared_axis_names() == {"data", "fsdp"}
+        assert len(model.declared_axes["data"]) == 2
+
+    def test_non_package_files_do_not_declare(self):
+        model, _ = self._model({
+            "tests/unit/test_x.py": """
+                from jax.sharding import Mesh
+                MY_AXIS = "rogue"
+
+                def build(devs):
+                    return Mesh(devs, axis_names=("adhoc", ))
+                """,
+        })
+        assert model.declared_axis_names() == set()
+
+    def test_spec_entries_resolve_aliases_and_mark_unresolved(self):
+        model, mods = self._model({
+            "deepspeed_tpu/parallel/mesh.py": 'TENSOR_AXIS = "tensor"\n',
+            "deepspeed_tpu/user.py": """
+                from .parallel.mesh import TENSOR_AXIS as TP
+                from jax.sharding import PartitionSpec
+
+                def specs(axis):
+                    return (PartitionSpec(None, TP, "data"),
+                            PartitionSpec(axis),
+                            PartitionSpec(("data", TP)))
+                """,
+        })
+        info = model.module_info(mods[1])
+        assert len(info.spec_sites) == 3
+        flat = [[u.axis for u in dim] for dim in info.spec_sites[0].entries]
+        assert flat == [[], ["tensor"], ["data"]]
+        assert info.spec_sites[0].rank == 3
+        assert [u.axis for u in info.spec_sites[1].axis_uses()] == ["?"]
+        assert [u.axis for u in info.spec_sites[2].axis_uses()] == ["data", "tensor"]
+
+    def test_starred_spec_has_unknown_rank(self):
+        model, mods = self._model({
+            "deepspeed_tpu/x.py": """
+                from jax.sharding import PartitionSpec
+
+                def spec(dims):
+                    return PartitionSpec(*dims)
+                """,
+        })
+        assert model.module_info(mods[0]).spec_sites[0].rank is None
+
+    def test_manifest_round_trip_and_version_guard(self, tmp_path):
+        path = str(tmp_path / ".dslint-mesh-manifest.json")
+        assert load_mesh_manifest(path) is None
+        save_mesh_manifest(path, {"data", "tensor"})
+        assert load_mesh_manifest(path) == {"data", "tensor"}
+        (tmp_path / ".dslint-mesh-manifest.json").write_text('{"version": 99}')
+        try:
+            load_mesh_manifest(path)
+        except ValueError as exc:
+            assert "version" in str(exc)
+        else:
+            raise AssertionError("bad version must be refused")
+
+    def test_creation_rank(self):
+        import ast as _ast
+
+        def rank_of(expr):
+            return creation_rank(_ast.parse(expr, mode="eval").body)
+
+        assert rank_of("jnp.zeros((4, 8))") == 2
+        assert rank_of("np.empty((m, 2), np.int32)") == 2
+        assert rank_of("jnp.full((a, b, c), 0)") == 3
+        assert rank_of("jnp.zeros(8)") == 1
+        assert rank_of("jnp.arange(8)") == 1
+        assert rank_of("jnp.zeros(shape)") is None
+        assert rank_of("fn(x)") is None
+
+
+# --------------------------------------------------------- in-tree acceptance
+def test_mesh_manifest_exactly_matches_the_tree():
+    """ISSUE 14 acceptance: the committed manifest equals the package's
+    declared axes — regeneration is a no-op diff."""
+    import os
+    from deepspeed_tpu.tools.staticcheck import collect_mesh_axes
+    from deepspeed_tpu.tools.staticcheck.mesh_model import (
+        DEFAULT_MESH_MANIFEST_NAME)
+    from deepspeed_tpu.tools.staticcheck.runner import iter_python_files
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    pkg = os.path.join(root, "deepspeed_tpu")
+    modules, errors = load_modules(iter_python_files([pkg]), root)
+    assert not errors
+    committed = load_mesh_manifest(os.path.join(root, DEFAULT_MESH_MANIFEST_NAME))
+    assert committed is not None, "mesh manifest must be committed"
+    assert committed == collect_mesh_axes(modules)
+    # the canonical six axes of parallel/mesh.py are all pinned
+    assert {"data", "fsdp", "tensor", "sequence", "expert", "pipe"} <= committed

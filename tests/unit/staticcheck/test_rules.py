@@ -693,5 +693,8 @@ def test_in_tree_acceptance_every_rule_demonstrated():
                       baseline=load_baseline(os.path.join(root, DEFAULT_BASELINE_NAME)))
     assert result.findings == [], "\n".join(f.format_text() for f in result.findings)
     assert result.files_checked > 100
-    assert result.seconds < 30  # the make-lint latency budget
+    # the make-lint latency budget: 15 rules + the cross-module mesh model
+    # must still fit the same full-tree bound (ISSUE 14 perf guard)
+    assert len(result.rules_run) == 15
+    assert result.seconds < 30
     assert result.suppressed_count > 0  # the written-reason suppressions exist
