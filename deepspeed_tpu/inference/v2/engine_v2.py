@@ -242,10 +242,13 @@ class InferenceEngineV2:
         self.max_blocks_per_seq = max_blocks_per_seq
         # serving fast path (ISSUE 5): persistent device-resident batch
         # buffers, deferred pick syncs, and host-link counters that make the
-        # orchestration cost observable (fastpath.py)
+        # orchestration cost observable (fastpath.py).  Under TP the batch
+        # state replicates over the engine's mesh (ISSUE 15) so the same
+        # ≤1-sync loop drives the shard_mapped forward unchanged.
         self.fastpath = self.config.serving_fastpath
         self.counters = ServeCounters()
-        self.batch_state = DeviceBatchState(self.counters)
+        self.batch_state = DeviceBatchState(
+            self.counters, mesh=self.topology.mesh if self.tp > 1 else None)
         self._inflight: Optional[DeferredTokens] = None
         self._table_width = 0
         self._table_slack = 0
@@ -375,12 +378,24 @@ class InferenceEngineV2:
         """Prewarm one (n_seqs, chunk, table_width) bucket ahead of the serve
         loop: lower + compile the ragged forward against abstract shapes and
         cache the executable, so the first mid-wave step that lands in the
-        bucket dispatches instead of stalling p95 on a compile."""
+        bucket dispatches instead of stalling p95 on a compile.
+
+        Under TP the avals carry the engine's mesh shardings (params/KV
+        sharded per their specs, batch buffers replicated — exactly what
+        DeviceBatchState commits at dispatch): an unsharded lowering would
+        build an executable the first sharded dispatch could never hit, so
+        the "prewarm" would silently recompile mid-wave anyway."""
         key = (n, t, b)
         if key in self._fwd_cache:
             return
-        ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
-        abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if self.tp > 1:
+            rep = self.topology.replicated()
+            ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32, sharding=rep)
+            abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                      sharding=x.sharding)
+        else:
+            ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+            abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
         compiled = self._build_fwd_jit().lower(
             jax.tree_util.tree_map(abstract, self.params),
             jax.tree_util.tree_map(abstract, self.kv),
@@ -400,7 +415,15 @@ class InferenceEngineV2:
             def copy(kv, pair):
                 return jax.tree_util.tree_map(
                     lambda leaf: leaf.at[:, pair[1]].set(leaf[:, pair[0]]), kv)
-            fn = jax.jit(copy, donate_argnums=(0, ))
+            if self.tp > 1:
+                # the pool's head-sharding must survive the copy: pin
+                # out_shardings to the live pool's NamedShardings so the
+                # donated sharded pool aliases in place instead of degrading
+                # to a gather + single-device copy
+                kv_sh = jax.tree_util.tree_map(lambda leaf: leaf.sharding, self.kv)
+                fn = jax.jit(copy, donate_argnums=(0, ), out_shardings=kv_sh)
+            else:
+                fn = jax.jit(copy, donate_argnums=(0, ))
             self._fwd_cache["cow_copy"] = fn
             self.counters.compiles += 1
         self.counters.dispatches += 1
@@ -456,10 +479,10 @@ class InferenceEngineV2:
         With the serving fast path enabled this is dispatch + immediate
         materialize over the persistent device batch buffers; the serve loop
         uses the split halves directly to defer the materialize by one step.
-        TP-sharded serving stays on the reference path: DeviceBatchState's
-        scatter commits its buffers to a single device, which a shard_mapped
-        forward over a real multi-device mesh would reject."""
-        if not self.fastpath.enabled or self.tp > 1:
+        TP-sharded engines ride the same path (ISSUE 15): DeviceBatchState
+        replicates its buffers over the mesh, so the shard_mapped forward
+        consumes them with zero resharding."""
+        if not self.fastpath.enabled:
             return self._step_reference(greedy)
         deferred = self._dispatch_step(greedy)
         if deferred is None:
@@ -1179,7 +1202,7 @@ class InferenceEngineV2:
         # an externally wrapped step() (fault injectors, tracing shims) must
         # keep intercepting every step, so the split dispatch/materialize
         # pipeline only engages on an unwrapped engine
-        can_pipeline = (fp.enabled and fp.pipeline_depth > 0 and self.tp == 1
+        can_pipeline = (fp.enabled and fp.pipeline_depth > 0
                         and "step" not in self.__dict__)
         stall_streak = 0
         last_sig = None
@@ -1448,7 +1471,7 @@ class InferenceEngineV2:
         mid-serve compile stalls.  Best-effort — any lowering failure falls
         back to compile-on-first-step."""
         fp = self.fastpath
-        if not fp.enabled or fp.prewarm_buckets <= 0 or self.tp > 1:
+        if not fp.enabled or fp.prewarm_buckets <= 0:
             return
         depth, max_prompt = self.admission.queued_stats()
         live = self.manager.live_uids()
@@ -1739,8 +1762,13 @@ class InferenceEngineV2:
             "stall_streak": self._stall_streak,
             "stalls_total": self.stalls_total,
             # host-link counters (ISSUE 5): the serve loop's orchestration
-            # cost, for probes that watch syncs-per-token drift
-            "fastpath": self.counters.snapshot(),
+            # cost, for probes that watch syncs-per-token drift — plus the
+            # parallelism shape (ISSUE 15) so the ops plane can tell a
+            # sharded serve apart from a single-chip one at a glance
+            "fastpath": {**self.counters.snapshot(), "tp": self.tp,
+                         "mesh_shape": ({a: int(s) for a, s in
+                                         self.topology.mesh.shape.items()}
+                                        if self.topology is not None else {})},
             # SLO latency percentiles (ISSUE 6): queue_wait histogram is fed
             # by the admission pump even with span tracing off; ttft/tbt/e2e
             # fill in once serving_tracing.enabled is set

@@ -27,6 +27,12 @@ This module holds the three host-link levers the engine composes:
 
 Nothing here schedules or owns sequences — that stays in the scheduler and
 the ragged manager; this is purely the host<->device traffic layer.
+
+Sharded serving (ISSUE 15): given the engine's mesh, :class:`DeviceBatchState`
+places its buffers REPLICATED over it (``NamedSharding(mesh,
+PartitionSpec())``) and pins replicated ``out_shardings`` on the donated
+scatter/feed programs, so the same ≤1-sync loop drives a shard_mapped
+forward under TP×DP meshes — the delta is broadcast once, never gathered.
 """
 
 import dataclasses
@@ -35,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 # host-side placeholder for a sampled-but-not-yet-fetched token.  Negative so
 # it can never collide with a real vocab id; it only ever appears as the LAST
@@ -195,15 +202,41 @@ class DeviceBatchState:
     (n_tokens=0, tables=trash) without ever re-uploading unchanged ones —
     a stale row left live would write KV into blocks the allocator may have
     handed to another sequence.
+
+    With a ``mesh`` (TP/DP-sharded serving, ISSUE 15) the persistent buffers
+    live REPLICATED over the whole mesh — every device sees the full padded
+    batch while params/KV carry the sharded dims, so the shard_mapped ragged
+    forward consumes them with zero resharding.  The delta upload is placed
+    replicated too, and the scatter/feed programs pin replicated
+    ``out_shardings`` so donation still aliases in place (XLA only aliases a
+    donated buffer when input and output shardings agree).  The per-step
+    host-link cost is unchanged: O(changed seqs) ints, broadcast once.
     """
 
-    def __init__(self, counters: ServeCounters):
+    def __init__(self, counters: ServeCounters, mesh=None):
         self.counters = counters
+        self._replicated = (NamedSharding(mesh, PartitionSpec())
+                            if mesh is not None else None)
         self._slots: Dict[Tuple[int, int, int], _Slot] = {}
         self._scatter_shapes: set = set()
         self._feed_shapes: set = set()
-        self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0, 1, 2, 3))
-        self._feed = jax.jit(self._feed_impl, donate_argnums=(0,))
+        if mesh is not None:
+            rep = self._replicated
+            self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0, 1, 2, 3),
+                                    out_shardings=(rep, rep, rep, rep))
+            self._feed = jax.jit(self._feed_impl, donate_argnums=(0,),
+                                 out_shardings=rep)
+        else:
+            self._scatter = jax.jit(self._scatter_impl, donate_argnums=(0, 1, 2, 3))
+            self._feed = jax.jit(self._feed_impl, donate_argnums=(0,))
+
+    def _device(self, arr: np.ndarray):
+        """Host->device upload: replicated over the mesh under sharded
+        serving (a committed single-device array would be rejected by the
+        shard_mapped forward), default placement otherwise."""
+        if self._replicated is not None:
+            return jax.device_put(arr, self._replicated)
+        return jnp.asarray(arr)
 
     @staticmethod
     def _scatter_impl(tokens, n_tokens, start_pos, tables, packed):
@@ -228,10 +261,10 @@ class DeviceBatchState:
             mirror = np.zeros((n, 3 + t + b), np.int32)
             mirror[:, 0] = np.arange(n)
             mirror[:, 3 + t:] = trash_block
-            s = _Slot(tokens=jnp.zeros((n, t), jnp.int32),
-                      n_tokens=jnp.zeros((n,), jnp.int32),
-                      start_pos=jnp.zeros((n,), jnp.int32),
-                      tables=jnp.full((n, b), trash_block, jnp.int32),
+            s = _Slot(tokens=self._device(np.zeros((n, t), np.int32)),
+                      n_tokens=self._device(np.zeros((n,), np.int32)),
+                      start_pos=self._device(np.zeros((n,), np.int32)),
+                      tables=self._device(np.full((n, b), trash_block, np.int32)),
                       mirror=mirror)
             self._slots[key] = s
         return s
@@ -275,7 +308,7 @@ class DeviceBatchState:
             self.counters.upload_ints += int(packed.size)
             self.counters.dispatches += 1
             s.tokens, s.n_tokens, s.start_pos, s.tables = self._scatter(
-                s.tokens, s.n_tokens, s.start_pos, s.tables, jnp.asarray(packed))
+                s.tokens, s.n_tokens, s.start_pos, s.tables, self._device(packed))
         return s
 
     def feed(self, key: Tuple[int, int, int], toks_prev,
@@ -296,7 +329,7 @@ class DeviceBatchState:
         self.counters.uploads += 1
         self.counters.upload_ints += int(arr.size)
         self.counters.dispatches += 1
-        s.tokens = self._feed(s.tokens, toks_prev, jnp.asarray(arr))
+        s.tokens = self._feed(s.tokens, toks_prev, self._device(arr))
 
     def forget(self) -> None:
         """Drop every slot (tests / bucket-policy changes)."""

@@ -483,6 +483,13 @@ class ServingFastpathConfig(ConfigModel):
     worth fusing into one on-device decode burst.  ``prewarm_buckets``
     bounds how many (batch, chunk, table) bucket programs ``generate()``
     AOT-compiles at intake so mid-wave recompiles stop stalling p95.
+
+    The whole fast path applies unchanged under TP×DP meshes (ISSUE 15):
+    the persistent batch buffers replicate over the engine's mesh
+    (``NamedSharding(mesh, PartitionSpec())``) while params/KV keep their
+    sharded specs, the delta scatter compiles as a sharded donated update,
+    and prewarm lowers against sharded avals — no knob selects this; the
+    engine's topology does.
     """
     enabled: bool = True
     pipeline_depth: int = Field(1, choices=(0, 1))

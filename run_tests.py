@@ -209,15 +209,24 @@ def serving_fastpath_smoke():
     tokens through fused decode bursts, (c) add ZERO compiled programs on an
     identical warm rerun (the compile-count invariant behind stable p95), and
     (d) produce byte-identical tokens to a ``serving_fastpath.enabled=False``
-    reference run."""
+    reference run.  The same invariants then rerun SHARDED (ISSUE 15): a
+    tp=2 engine over the 8-device host mesh must match the slow-path oracle
+    AND the single-chip tokens with the identical counter bounds."""
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # 8 host devices BEFORE the first jax import: the tp=2 leg below
+        # needs a real multi-device mesh (same trick as tests/conftest.py)
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
     import numpy as np
 
     from deepspeed_tpu.inference.v2 import InferenceEngineV2
     from deepspeed_tpu.models import llama
+    from deepspeed_tpu.parallel import MeshTopology
 
     cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -247,6 +256,30 @@ def serving_fastpath_smoke():
     c2 = fast.counters.delta_since(c1)
     assert c2["compiles"] == 0, f"identical warm scenario recompiled: {c2}"
 
+    # ---- the same invariants, SHARDED (ISSUE 15): tp=2 over the 8-device
+    # host mesh.  Byte-identical to the sharded slow-path oracle AND to the
+    # single-chip fast path, <=1 host sync per steady iteration, zero warm
+    # recompiles — the fast path no longer falls back under TP.
+    topo = MeshTopology.from_axis_dict({"tensor": 2, "data": -1})
+    fast_tp = InferenceEngineV2(llama, cfg, params, topology=topo,
+                                config={"dtype": "float32"}, **kw)
+    ref_tp = InferenceEngineV2(llama, cfg, params, topology=topo,
+                               config={"dtype": "float32",
+                                       "serving_fastpath": {"enabled": False}}, **kw)
+    out_tp = fast_tp.generate(prompts, max_new_tokens=8)
+    assert out_tp == ref_tp.generate(prompts, max_new_tokens=8), \
+        "tp=2 fast path diverged from the sharded reference loop"
+    assert out_tp == out_fast, "tp=2 serving diverged from single-chip tokens"
+    ct1 = fast_tp.counters.snapshot()
+    assert ct1["host_syncs"] <= ct1["loop_iterations"] + ct1["flushes"], ct1
+    assert ct1["burst_tokens"] > ct1["step_tokens"], ct1
+    assert out_tp == fast_tp.generate(prompts, max_new_tokens=8), \
+        "tp=2 warm rerun diverged"
+    ct2 = fast_tp.counters.delta_since(ct1)
+    assert ct2["compiles"] == 0, f"tp=2 warm scenario recompiled: {ct2}"
+    hp = fast_tp.health()["fastpath"]
+    assert hp["tp"] == 2 and hp["mesh_shape"]["tensor"] == 2, hp
+
     print(json.dumps({"serving_fastpath_smoke": "ok",
                       "host_syncs": c1["host_syncs"],
                       "loop_iterations": c1["loop_iterations"],
@@ -254,7 +287,11 @@ def serving_fastpath_smoke():
                       "compiled_programs": c1["compiles"],
                       "burst_tokens": c1["burst_tokens"],
                       "step_tokens": c1["step_tokens"],
-                      "warm_rerun_compiles": c2["compiles"]}))
+                      "warm_rerun_compiles": c2["compiles"],
+                      "tp2_host_syncs": ct1["host_syncs"],
+                      "tp2_loop_iterations": ct1["loop_iterations"],
+                      "tp2_compiled_programs": ct1["compiles"],
+                      "tp2_warm_rerun_compiles": ct2["compiles"]}))
     return 0
 
 
