@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -128,3 +128,17 @@ serving-recovery-smoke:
 # /proc shows zero orphaned workers; also a lane in run_tests.py
 elastic-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --elastic-smoke
+
+# serving perf observatory (ISSUE 16): 3-wave mixed-arrival serve with the
+# observatory ON — every phase family non-empty with spans summing to the
+# iteration wall, zero warm recompiles, full roofline cost coverage, the new
+# serving_phase/compiles/recompiles/roofline families strict-parsing off a
+# live /metrics scrape, and tokens + ServeCounters byte-identical vs off
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --perf-smoke
+
+# bench regression gate (ISSUE 16): bin/dstpu-benchdiff under the committed
+# benchtrack.json policy — the committed BENCH_r04->r05 pair must pass and an
+# injected 30% serving-throughput regression must exit 1
+bench-diff:
+	$(PY) run_tests.py --bench-diff

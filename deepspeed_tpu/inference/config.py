@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
                               ServingFastpathConfig,
                               ServingFaultToleranceConfig,
+                              ServingPerfConfig,
                               ServingPrefixCacheConfig,
                               ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
@@ -69,6 +70,11 @@ class InferenceConfig(ConfigModel):
     # inference/v2/ragged_manager.py PrefixCache (section defined in
     # runtime/config.py so train+serve configs share one spelling)
     serving_prefix_cache: ServingPrefixCacheConfig = Field(ServingPrefixCacheConfig)
+    # serving performance observatory: phase attribution + compile ledger +
+    # live roofline gauges — monitor/perf.py wired through the v2 serve loop
+    # (section defined in runtime/config.py so train+serve configs share one
+    # spelling)
+    serving_perf: ServingPerfConfig = Field(ServingPerfConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

@@ -10,6 +10,7 @@ one per ragged shape — the XLA analog of the reference's CUDA-graph-free
 ragged kernels.
 """
 
+import contextlib
 import inspect
 import json
 import os
@@ -22,6 +23,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 from ...compat import shard_map
+from ...monitor.perf import CompileLedger, RooflineModel, StepPhaseProfiler
 from ...monitor.tracing import RequestTracer
 from ...parallel.mesh import TENSOR_AXIS, MeshTopology
 from ...runtime.heartbeat import (HEARTBEAT_DIR_ENV, HEARTBEAT_INTERVAL_ENV,
@@ -247,8 +249,20 @@ class InferenceEngineV2:
         # ≤1-sync loop drives the shard_mapped forward unchanged.
         self.fastpath = self.config.serving_fastpath
         self.counters = ServeCounters()
+        # serving performance observatory (ISSUE 16): the compile ledger and
+        # roofline cost capture are always on (no clock reads, no device
+        # work) and the ledger is the single source of truth behind
+        # counters.compiles; the phase profiler reads the injectable clock at
+        # phase boundaries and is gated on serving_perf.enabled so the off
+        # path performs zero extra clock reads (byte-identical FakeClock runs)
+        self.perf_cfg = self.config.serving_perf
+        self.ledger = CompileLedger(self.counters, tracer=self.tracer)
+        self.phase_profiler = StepPhaseProfiler(self.perf_cfg, clock=self._clock,
+                                                tracer=self.tracer)
+        self.roofline = RooflineModel(self.perf_cfg)
         self.batch_state = DeviceBatchState(
-            self.counters, mesh=self.topology.mesh if self.tp > 1 else None)
+            self.counters, mesh=self.topology.mesh if self.tp > 1 else None,
+            ledger=self.ledger)
         self._inflight: Optional[DeferredTokens] = None
         self._table_width = 0
         self._table_slack = 0
@@ -370,11 +384,23 @@ class InferenceEngineV2:
     def _compiled_fwd(self, n: int, t: int, b: int):
         key = (n, t, b)
         if key not in self._fwd_cache:
-            self._fwd_cache[key] = self._build_fwd_jit()
-            self.counters.compiles += 1
+            try:
+                # compile ahead-of-time even for buckets the prewarm missed:
+                # the ledger gets the real compile wall time and the roofline
+                # gets cost_analysis coverage for EVERY dispatched bucket,
+                # instead of only the prewarmed ones (ISSUE 16)
+                self._aot_compile_fwd(n, t, b, prewarmed=False)
+            except Exception:
+                # AOT lowering can fail where plain jit works (backend
+                # quirks); serving must degrade to the lazy wrapper, not die
+                self._fwd_cache[key] = self._build_fwd_jit()
+                # lazy jit wrapper: XLA compiles at first dispatch, so the
+                # wall time shows up in the dispatch phase histogram instead
+                self.ledger.record("fwd", key)
         return self._fwd_cache[key]
 
-    def _aot_compile_fwd(self, n: int, t: int, b: int) -> None:
+    def _aot_compile_fwd(self, n: int, t: int, b: int, *,
+                         prewarmed: bool = True) -> None:
         """Prewarm one (n_seqs, chunk, table_width) bucket ahead of the serve
         loop: lower + compile the ragged forward against abstract shapes and
         cache the executable, so the first mid-wave step that lands in the
@@ -396,12 +422,30 @@ class InferenceEngineV2:
         else:
             ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
             abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        # time.perf_counter, not the injectable clock: this is a host-side
+        # duration (XLA compiles synchronously here), and reading the engine
+        # clock would shift FakeClock-driven deadline semantics with the
+        # observatory on — the ledger must never perturb what it measures
+        t0 = time.perf_counter()  # dslint: disable=raw-clock-in-serving  # genuinely wall-clock-only: measuring the synchronous XLA compile itself; reading the injectable clock here would shift FakeClock-driven deadline semantics with the observatory on
         compiled = self._build_fwd_jit().lower(
             jax.tree_util.tree_map(abstract, self.params),
             jax.tree_util.tree_map(abstract, self.kv),
             ints((n, t)), ints((n, )), ints((n, )), ints((n, b))).compile()
         self._fwd_cache[key] = compiled
-        self.counters.compiles += 1
+        self.ledger.record("fwd", key, wall_s=time.perf_counter() - t0,  # dslint: disable=raw-clock-in-serving  # same stopwatch as t0 above — host compile duration, never the engine clock
+                           prewarmed=prewarmed)
+        if self.perf_cfg.capture_cost_analysis:
+            # the ONE seam holding a compiled executable: capture the
+            # compiler's own per-invocation cost numbers for the roofline
+            # (plain floats cross into monitor/perf.py — never a jax object)
+            try:
+                cost = compiled.cost_analysis()
+                if isinstance(cost, list):  # older jax returns [dict]
+                    cost = cost[0] if cost else {}
+                self.roofline.note_cost(key, float(cost.get("flops", 0.0)),
+                                        float(cost.get("bytes accessed", 0.0)))
+            except Exception:  # dslint: disable=silent-except  # cost analysis is best-effort: some backends/executables can't report costs, and the roofline must never break prewarm
+                pass
 
     def _cow_copy_block(self, src: int, dst: int) -> None:
         """Copy-on-write block duplication (ISSUE 13): copy one KV block's
@@ -425,7 +469,7 @@ class InferenceEngineV2:
             else:
                 fn = jax.jit(copy, donate_argnums=(0, ))
             self._fwd_cache["cow_copy"] = fn
-            self.counters.compiles += 1
+            self.ledger.record("cow_copy", "cow_copy")
         self.counters.dispatches += 1
         self.counters.uploads += 1
         self.counters.upload_ints += 2
@@ -540,6 +584,7 @@ class InferenceEngineV2:
                                        trash_block=self.manager.trash_block)
         if feeds:
             self.batch_state.feed(key, self._inflight.toks_dev, feeds)
+        self.phase_profiler.mark("scatter_upload")
         fwd = self._compiled_fwd(n, t, b)
         self.counters.dispatches += 1
         logits, self.kv = fwd(self.params, self.kv, slot.tokens, slot.n_tokens,
@@ -550,6 +595,8 @@ class InferenceEngineV2:
         pick = self._compiled_step_pick(n, greedy)
         self.counters.dispatches += 1
         toks_dev, self._rng = pick(logits, slot.n_tokens, self._rng)
+        self.phase_profiler.mark("dispatch")
+        self.roofline.note_dispatch(key, tokens_run)
         emits = []
         row_of: Dict[int, int] = {}
         for i, c in enumerate(chunks):
@@ -795,6 +842,12 @@ class InferenceEngineV2:
         # SLO percentile gauges (ISSUE 6): ttft/tbt/e2e/queue_wait p50/p95/p99
         # from the tracer's streaming histograms ({} while tracing is off)
         gauges.update(self.tracer.gauge_fields())
+        # live roofline gauges (ISSUE 16): HBM bytes/token and achieved
+        # fractions of the HBM/FLOPs specs — meaningful rates need measured
+        # wall time, which only the enabled phase profiler accumulates
+        if self.perf_cfg.enabled:
+            gauges.update(self.roofline.gauges(self.phase_profiler.wall_s))
+            gauges["serving_warm_recompiles"] = float(self.ledger.warm_total)
         rps = self.telemetry.rate("v2_completed_requests",
                                   float(self.manager.completed_requests))
         if rps is not None:
@@ -806,6 +859,23 @@ class InferenceEngineV2:
         self.telemetry.record_gauges(gauges, step=self.scheduler.steps,
                                      prefix="Inference/Serving",
                                      timestamp=self._gauge_timestamp())
+
+    def _phase_annotation(self, name: str):
+        """jax.profiler TraceAnnotation for one serve phase while a capture
+        window is open (ISSUE 16 satellite) — a nullcontext otherwise, so the
+        un-profiled serve loop pays one attribute check per phase."""
+        t = self.telemetry
+        if t is not None and t.tracing:
+            return t.annotation(name)
+        return contextlib.nullcontext()
+
+    def _perf_snapshot(self) -> Dict[str, Any]:
+        """Host-side perf observatory snapshot (ISSUE 16): phase attribution,
+        compile ledger, roofline — everything health()/statez surface."""
+        snap = self.phase_profiler.snapshot()  # enabled/iterations/wall_s/phases
+        snap["compile_ledger"] = self.ledger.snapshot()
+        snap["roofline"] = self.roofline.snapshot(self.phase_profiler.wall_s)
+        return snap
 
     def _compiled_step_pick(self, n: int, greedy: bool):
         key = ("pick", n, greedy, self.config.temperature, self.config.top_k,
@@ -825,7 +895,7 @@ class InferenceEngineV2:
                 return _sample(row, rng, temperature=temperature, top_k=top_k, top_p=top_p)
 
             self._fwd_cache[key] = jax.jit(pick)
-            self.counters.compiles += 1
+            self.ledger.record("pick", key)
         return self._fwd_cache[key]
 
     # ------------------------------------------------------------ decode burst
@@ -911,7 +981,7 @@ class InferenceEngineV2:
                 burst = self._shard_mapped(
                     burst, (self._kv_specs, PartitionSpec(), PartitionSpec()))
             self._fwd_cache[key] = jax.jit(burst, donate_argnums=(1, ))  # dslint: disable=donation-after-use  # call-site contract: decode_burst() reassigns self.kv from the result in the same statement
-            self.counters.compiles += 1
+            self.ledger.record("burst", key)
         return self._fwd_cache[key]
 
     def decode_burst(self, k: int, greedy: bool = True,
@@ -1166,6 +1236,10 @@ class InferenceEngineV2:
                                   for uid, prompt in zip(uids, prompts)
                                   if uid not in results})
             self._prewarm(max_new_tokens)
+            if self.telemetry is not None:
+                # re-arm the serve-loop jax.profiler window for THIS
+                # generate() (ISSUE 16 satellite — one window per call)
+                self.telemetry.serve_profile_begin()
             self._serve_loop(uids, my, results, produced, max_new_tokens=max_new_tokens,
                              eos_token_id=eos_token_id, greedy=greedy, strict=strict)
             # post-pass pool state: final census/forecast refresh, then the
@@ -1181,6 +1255,10 @@ class InferenceEngineV2:
             self._abandon(my, results)
             raise
         finally:
+            if self.telemetry is not None:
+                # a serve capture window must never leak across generate()
+                # calls — close it even on a strict raise
+                self.telemetry.serve_profile_end()
             # flush the Chrome-trace export (if configured) even on a strict
             # raise — the partial trace is exactly what the postmortem wants
             self.tracer.write_chrome_trace()
@@ -1206,6 +1284,8 @@ class InferenceEngineV2:
                         and "step" not in self.__dict__)
         stall_streak = 0
         last_sig = None
+        prof = self.phase_profiler
+        serve_iter = 0  # per-generate index driving the serve profiler window
 
         def absorb(stepped):
             self._absorb_step(stepped, my, results, produced,
@@ -1214,6 +1294,13 @@ class InferenceEngineV2:
 
         while any(u not in results for u in uids):
             self.counters.loop_iterations += 1
+            if self.telemetry is not None:
+                # serve-loop jax.profiler capture window (ISSUE 16 satellite):
+                # [start, stop) in per-generate iterations, one window per
+                # generate() — a no-op unless the window knobs are set
+                self.telemetry.profile_serve_boundary(serve_iter)
+            serve_iter += 1
+            prof.begin_iteration()
             # serve-iteration liveness stamp (ISSUE 8): phase "serving" on
             # host-owned ints only — the supervisor reads staleness as a hang.
             # Throttled inside the writer; NULL writer when supervision is off
@@ -1221,6 +1308,7 @@ class InferenceEngineV2:
             # ops-plane cache refresh (ISSUE 11): host-only snapshot rebuild,
             # throttled on the injectable clock; a no-op with the plane off
             self.refresh_ops()
+            prof.mark("other")  # liveness/ops bookkeeping, not a serve phase
             if self._inflight is not None and (len(self.admission)
                                                or self._any_live_deadline()):
                 # wave boundary: admission/deadline handling below may evict
@@ -1229,8 +1317,11 @@ class InferenceEngineV2:
                 self.counters.flushes += 1
                 self.tracer.event("flush", step=self.scheduler.steps, cause="wave")
                 absorb(self._settle_inflight())
+                prof.mark("flush")
             self._expire_live()
-            self._pump_admissions(my, results, strict)
+            with self._phase_annotation("admission_pump"):
+                self._pump_admissions(my, results, strict)
+            prof.mark("admission_pump")
 
             # pure-decode fast path: burst k steps on device (greedy or
             # sampled; eos-aware via the carried done-mask).  The pump just
@@ -1254,9 +1345,12 @@ class InferenceEngineV2:
                 self.counters.flushes += 1
                 self.tracer.event("flush", step=self.scheduler.steps, cause="fuse")
                 absorb(self._settle_inflight())
+                prof.mark("flush")
                 k = self._fusion_window(uids, results, produced, max_new_tokens)
             if fusible and k >= fusion_min:
-                burst = self.decode_burst(k, greedy=greedy, eos_token_id=eos_token_id)
+                with self._phase_annotation("burst"):
+                    burst = self.decode_burst(k, greedy=greedy,
+                                              eos_token_id=eos_token_id)
                 if burst:
                     for uid, toks in burst.items():
                         if uid not in my or uid in results:
@@ -1267,7 +1361,10 @@ class InferenceEngineV2:
                         if hit_eos or produced[uid] >= max_new_tokens:
                             self._finish_ok(uid, results,
                                             "eos" if hit_eos else "max_new_tokens")
+                    prof.mark("burst")
+                    prof.end_iteration()
                     continue
+                prof.mark("burst")  # a declined burst attempt still costs time
 
             if can_pipeline and not (len(self.admission) or self._any_live_deadline()):
                 # async step pipelining: dispatch step N, then absorb step
@@ -1281,17 +1378,24 @@ class InferenceEngineV2:
                     # in-flight step lands — absorb it instead of dispatching
                     # a guaranteed-overshoot step
                     absorb(self._settle_inflight())
+                    prof.mark("absorb_patch")
                 else:
-                    deferred = self._dispatch_step(greedy)
+                    with self._phase_annotation("dispatch"):
+                        deferred = self._dispatch_step(greedy)
                     prev, self._inflight = self._inflight, deferred
-                    absorb(prev.patch(self.manager) if prev is not None else {})
+                    with self._phase_annotation("absorb_patch"):
+                        absorb(prev.patch(self.manager) if prev is not None else {})
+                    prof.mark("absorb_patch")
             else:
                 if self._inflight is not None:
                     self.counters.flushes += 1
                     self.tracer.event("flush", step=self.scheduler.steps,
                                       cause="sync")
                     absorb(self._settle_inflight())
-                absorb(self.step(greedy=greedy))
+                    prof.mark("flush")
+                with self._phase_annotation("dispatch"):
+                    absorb(self.step(greedy=greedy))
+                prof.mark("absorb_patch")
 
             # ---- progress watchdog: a live-but-unschedulable engine must trip,
             # not spin.  The signature covers every observable scheduling input;
@@ -1312,6 +1416,7 @@ class InferenceEngineV2:
                 # materialized is already host-side, so the delta frame costs
                 # one buffered file append (fsync amortized per fsync_every)
                 self.journal.flush()
+            prof.end_iteration()  # residual (watchdog, WAL) lands in "other"
 
         if self._inflight is not None:
             # the final absorb resolved every request with a step still in
@@ -1536,6 +1641,9 @@ class InferenceEngineV2:
             self._record_resilience("serving_deadline_expired", uid=seq.uid,
                                     produced=seq.generated_tokens,
                                     seen_tokens=seq.seen_tokens)
+        # phase attribution (ISSUE 16): a no-op (and no clock read) unless
+        # the profiler is enabled AND inside a serve-loop iteration
+        self.phase_profiler.mark("expire")
 
     def _pump_admissions(self, my: set, results: Dict[int, RequestResult],
                          strict: bool) -> bool:
@@ -1704,6 +1812,10 @@ class InferenceEngineV2:
             # recovery state (ISSUE 8): restart/recovery counters + journal
             # size, so a crash postmortem's snapshot shows the durability side
             "fault_tolerance": self._fault_tolerance_snapshot(),
+            # perf observatory (ISSUE 16): phase budget + compile provenance
+            # ride the stall dump — a wedge preceded by warm recompiles or a
+            # phase blowup is diagnosable from the snapshot alone
+            "perf": self._perf_snapshot(),
             # the event history that LED here (ISSUE 6): the always-on flight
             # recorder's tail rides every stall dump for postmortems
             "flight_recorder": self.tracer.recorder.tail(),
@@ -1779,6 +1891,10 @@ class InferenceEngineV2:
             # requests recovered with an emitted prefix, journal size on
             # disk, and the drain-only degradation flag
             "fault_tolerance": self._fault_tolerance_snapshot(),
+            # serving performance observatory (ISSUE 16): per-phase wall-time
+            # attribution, compile provenance, live roofline — the ledger and
+            # roofline report even with the phase profiler off
+            "perf": self._perf_snapshot(),
             # the recent engine-event history (always on, bounded ring)
             "flight_recorder": self.tracer.recorder.tail(32),
         }

@@ -213,8 +213,13 @@ class DeviceBatchState:
     host-link cost is unchanged: O(changed seqs) ints, broadcast once.
     """
 
-    def __init__(self, counters: ServeCounters, mesh=None):
+    def __init__(self, counters: ServeCounters, mesh=None, ledger=None):
         self.counters = counters
+        # compile ledger (ISSUE 16): when attached, scatter/feed shape builds
+        # are recorded there (site + key + class) and the ledger bumps
+        # counters.compiles — the counter's values are unchanged, its units
+        # just gain provenance; without a ledger the direct bump remains
+        self._ledger = ledger
         self._replicated = (NamedSharding(mesh, PartitionSpec())
                             if mesh is not None else None)
         self._slots: Dict[Tuple[int, int, int], _Slot] = {}
@@ -303,7 +308,10 @@ class DeviceBatchState:
             sig = (key, m_pad)
             if sig not in self._scatter_shapes:
                 self._scatter_shapes.add(sig)
-                self.counters.compiles += 1
+                if self._ledger is not None:
+                    self._ledger.record("scatter", sig)
+                else:
+                    self.counters.compiles += 1
             self.counters.uploads += 1
             self.counters.upload_ints += int(packed.size)
             self.counters.dispatches += 1
@@ -325,7 +333,10 @@ class DeviceBatchState:
         sig = (key, int(toks_prev.shape[0]), m_pad)
         if sig not in self._feed_shapes:
             self._feed_shapes.add(sig)
-            self.counters.compiles += 1
+            if self._ledger is not None:
+                self._ledger.record("feed", sig)
+            else:
+                self.counters.compiles += 1
         self.counters.uploads += 1
         self.counters.upload_ints += int(arr.size)
         self.counters.dispatches += 1

@@ -559,6 +559,62 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
     for name, hist in engine.tracer.histograms().items():
         reg.set_histogram(f"{reg.namespace}_request_{name}_seconds", hist,
                           help_text=hist_help[name])
+    # serving performance observatory (ISSUE 16): per-phase wall-time
+    # histograms, compile provenance counters, warm-recompile counters, and
+    # the live roofline gauges — all host-side values the engine's perf
+    # instruments already hold (the ledger/roofline sections export even with
+    # the phase profiler off; phase + roofline-rate families need it on)
+    profiler = getattr(engine, "phase_profiler", None)
+    if profiler is not None:
+        for phase, hist in profiler.histograms().items():
+            reg.set_histogram(f"{reg.namespace}_serving_phase_seconds", hist,
+                              labels={"phase": phase},
+                              help_text="serve-iteration wall time attributed "
+                                        "per phase (spans sum to the full "
+                                        "iteration wall)")
+        if profiler.enabled:
+            reg.set_counter(f"{reg.namespace}_serving_phase_iterations_total",
+                            profiler.iterations,
+                            help_text="serve iterations the phase profiler "
+                                      "attributed")
+    ledger = getattr(engine, "ledger", None)
+    if ledger is not None:
+        for site, classes in sorted(ledger.by_site.items()):
+            for cls, count in sorted(classes.items()):
+                reg.set_counter(f"{reg.namespace}_serving_compiles_total",
+                                count, labels={"site": site, "class": cls},
+                                help_text="XLA compiles attributed by jit "
+                                          "site and class (prewarmed/cold/"
+                                          "warm) — sums to "
+                                          "fastpath_compiles_total")
+            # a zero per seen site keeps the recompile family present and
+            # alert-able before the first (hopefully never) warm recompile
+            reg.set_counter(f"{reg.namespace}_serving_recompiles_total",
+                            ledger.warm_by_site.get(site, 0),
+                            labels={"site": site},
+                            help_text="warm recompiles: a bucket key rebuilt "
+                                      "after being seen at its site (runtime "
+                                      "twin of dslint's recompile-risk rule)")
+    roofline = getattr(engine, "roofline", None)
+    if roofline is not None and profiler is not None and profiler.enabled:
+        for name, value in roofline.gauges(profiler.wall_s).items():
+            reg.set_gauge(f"{reg.namespace}_{name}", value,
+                          help_text={
+                              "serving_hbm_bytes_per_token":
+                                  "HBM bytes accessed per served token "
+                                  "(cost_analysis over dispatched buckets)",
+                              "serving_roofline_fraction":
+                                  "achieved HBM bandwidth over the chip spec "
+                                  "(live twin of BENCH's "
+                                  "hbm_stream_fraction_of_spec)",
+                              "serving_model_flops_utilization":
+                                  "achieved FLOPs over peak (0 until "
+                                  "serving_perf.peak_flops_per_chip is set)",
+                          }[name])
+        reg.set_counter(f"{reg.namespace}_serving_uncosted_dispatches_total",
+                        roofline.uncosted_dispatches,
+                        help_text="dispatches of buckets with no captured "
+                                  "cost analysis (roofline blind spots)")
 
 
 def populate_from_telemetry(reg: MetricsRegistry, collector) -> None:

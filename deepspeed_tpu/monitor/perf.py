@@ -1,0 +1,301 @@
+"""Serving performance observatory (ISSUE 16).
+
+Three instruments that let the v2 serving stack attribute where a serve
+iteration's wall-clock went, where every XLA compile came from, and how close
+decode is running to the HBM roofline — the serving twin of the training-side
+``wall_clock_breakdown`` + flops-profiler story (PARITY rows 43/57):
+
+- :class:`StepPhaseProfiler` — mark-based per-iteration phase attribution for
+  the serve loop.  The engine calls ``begin_iteration()`` at the top of each
+  loop pass and ``mark(phase)`` after each phase's work; the profiler charges
+  the time since the previous mark to that phase and sends whatever is left at
+  ``end_iteration()`` to the ``other`` phase, so per-iteration phase spans sum
+  to the iteration wall time *exactly* (FakeClock tests assert equality, not
+  tolerance).  Per-phase :class:`~.tracing.StreamingHistogram` s give
+  deterministic quantiles; every phase marked in an iteration records one
+  sample (a 0.0 span lands in the underflow bucket, so families fill even
+  under a zero-tick FakeClock).
+- :class:`CompileLedger` — single source of truth for ``ServeCounters.compiles``.
+  Every compile seam (engine fwd buckets, AOT prewarm, pick/burst programs,
+  cow-copy, fastpath scatter/feed) records ``(site, key)`` here; the ledger
+  classifies each as ``prewarmed`` / ``cold`` / first-seen vs ``warm``
+  (a key recompiled after being seen — the runtime twin of dslint's
+  ``recompile-risk`` rule) and bumps the counter exactly once per record, so
+  counter values are unchanged from the pre-ledger ``+= 1`` sites.
+- :class:`RooflineModel` — per-bucket FLOPs + HBM bytes captured once at AOT
+  compile time from ``compiled.cost_analysis()`` (the engine passes plain
+  floats; this module never sees a jax object), accumulated per dispatch into
+  live ``hbm_bytes_per_token`` / ``roofline_fraction`` /
+  ``model_flops_utilization`` gauges — the live counterpart of BENCH's
+  ``hbm_stream_fraction_of_spec``.
+
+Zero-device-sync contract (same as heartbeat/metrics/exposition/ops_server,
+enforced by the dslint whole-file scan): nothing here imports jax or numpy,
+and every timestamp is a host float handed in by the engine's injectable
+clock.  The profiler reads that clock ONLY while ``enabled`` — observatory
+off adds zero clock reads, so FakeClock call counts (and therefore tokens and
+``ServeCounters``) are byte-identical with the observatory on or off.
+"""
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .tracing import StreamingHistogram
+
+# serve-loop phases, in rough per-iteration order; ``other`` absorbs the
+# residual (heartbeat stamp, ops refresh, watchdog, journal flush) so the
+# per-iteration spans always sum to the full iteration wall time
+PHASES = ("admission_pump", "scatter_upload", "dispatch", "absorb_patch",
+          "burst", "flush", "expire", "other")
+
+# compile classes a ledger record can carry
+CLASS_PREWARMED = "prewarmed"  # built ahead of traffic by _prewarm
+CLASS_COLD = "cold"            # first build of this (site, key) under traffic
+CLASS_WARM = "warm"            # rebuilt after already being seen: a recompile
+
+
+class StepPhaseProfiler:
+    """Mark-based phase attribution for the serve loop.
+
+    ``begin_iteration()`` opens an iteration; ``mark(phase)`` charges the time
+    since the previous mark (or the iteration start) to ``phase``;
+    ``end_iteration()`` charges the residual to ``other``, folds the
+    per-iteration spans into per-phase histograms and lifetime totals, and
+    optionally emits an every-N-iterations phase-budget line to the flight
+    recorder.  Marks outside an open iteration are ignored (the engine's
+    public ``step()``/``decode_burst()`` run outside the serve loop too).
+    """
+
+    def __init__(self, config=None, *, clock: Optional[Callable[[], float]] = None,
+                 tracer=None):
+        cfg = config
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self.budget_every = int(getattr(cfg, "phase_budget_every", 50))
+        bpd = int(getattr(cfg, "histogram_buckets_per_decade", 6))
+        min_s = float(getattr(cfg, "histogram_min_s", 1e-7))
+        self._clock = clock if clock is not None else (lambda: 0.0)
+        self._tracer = tracer
+        self.hists: Dict[str, StreamingHistogram] = {
+            p: StreamingHistogram(buckets_per_decade=bpd, min_value=min_s)
+            for p in PHASES}
+        self.totals: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self.iterations = 0
+        self.wall_s = 0.0
+        self._active = False
+        self._t_iter0 = 0.0
+        self._t_mark = 0.0
+        self._spans: Dict[str, float] = {}
+        # window accumulator for the flight-recorder phase-budget line
+        self._win_spans: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self._win_iters = 0
+
+    def begin_iteration(self) -> None:
+        if not self.enabled:
+            return
+        now = self._clock()
+        self._active = True
+        self._t_iter0 = now
+        self._t_mark = now
+        self._spans = {}
+
+    def mark(self, phase: str) -> None:
+        """Charge time since the previous mark to ``phase``."""
+        if not self.enabled or not self._active:
+            return
+        now = self._clock()
+        span = now - self._t_mark
+        self._t_mark = now
+        self._spans[phase] = self._spans.get(phase, 0.0) + span
+
+    def end_iteration(self) -> None:
+        if not self.enabled or not self._active:
+            return
+        now = self._clock()
+        self._spans["other"] = self._spans.get("other", 0.0) + (now - self._t_mark)
+        self._active = False
+        wall = now - self._t_iter0
+        self.iterations += 1
+        self.wall_s += wall
+        self._win_iters += 1
+        start = self._t_iter0
+        for phase, span in self._spans.items():
+            self.hists[phase].add(span)
+            self.totals[phase] += span
+            self._win_spans[phase] += span
+            if self._tracer is not None:
+                self._tracer.phase_span(phase, start, span,
+                                        track=PHASES.index(phase))
+            start += span
+        if self._win_iters >= self.budget_every:
+            self._emit_budget()
+
+    def _emit_budget(self) -> None:
+        """Flight-recorder line: where the last window's wall time went."""
+        if self._tracer is not None:
+            total = sum(self._win_spans.values()) or 1.0
+            fields = {p: round(self._win_spans[p], 6) for p in PHASES
+                      if self._win_spans[p] > 0.0}
+            top = max(self._win_spans, key=lambda p: self._win_spans[p])
+            self._tracer.event("phase_budget", iters=self._win_iters,
+                               wall_s=round(total, 6), top=top, **fields)
+        self._win_spans = {p: 0.0 for p in PHASES}
+        self._win_iters = 0
+
+    def histograms(self) -> Dict[str, StreamingHistogram]:
+        """Per-phase histograms that have seen at least one sample."""
+        return {p: h for p, h in self.hists.items() if h.count}
+
+    def snapshot(self) -> Dict[str, Any]:
+        phases = {p: dict(self.hists[p].snapshot(),
+                          total_s=round(self.totals[p], 9))
+                  for p in PHASES if self.hists[p].count}
+        return {"enabled": self.enabled, "iterations": self.iterations,
+                "wall_s": round(self.wall_s, 9), "phases": phases}
+
+    def reset(self) -> None:
+        for h in self.hists.values():
+            h.reset()
+        self.totals = {p: 0.0 for p in PHASES}
+        self.iterations = 0
+        self.wall_s = 0.0
+        self._active = False
+        self._win_spans = {p: 0.0 for p in PHASES}
+        self._win_iters = 0
+
+
+class CompileLedger:
+    """Attributed record of every XLA compile the serving engine triggers.
+
+    Always on (it adds no clock reads and no device work): each compile seam
+    calls :meth:`record` instead of bumping ``ServeCounters.compiles``
+    directly, and the ledger bumps the counter exactly once per record — the
+    counter's values are unchanged, but every unit now carries a jit-site
+    name, a bucket key, a class, and (for AOT prewarm, the only seam where
+    the compile happens synchronously on the host) a wall time.  A ``warm``
+    record — a key rebuilt after already being seen at its site — is the
+    runtime event dslint's ``recompile-risk`` rule predicts statically; it
+    lands in the flight recorder and the per-site warm counters behind
+    ``serving_recompiles_total{site=...}``.
+    """
+
+    def __init__(self, counters=None, *, tracer=None):
+        self._counters = counters
+        self._tracer = tracer
+        self._seen: Dict[Tuple[str, str], int] = {}
+        self.by_site: Dict[str, Dict[str, int]] = {}
+        self.warm_by_site: Dict[str, int] = {}
+        self.compile_wall_s = 0.0
+        self.total = 0
+        self.events: collections.deque = collections.deque(maxlen=256)
+
+    @staticmethod
+    def _key_str(key: Any) -> str:
+        return key if isinstance(key, str) else repr(key)
+
+    def record(self, site: str, key: Any, *, wall_s: float = 0.0,
+               prewarmed: bool = False) -> str:
+        """Record one compile at ``site`` for bucket ``key``; returns class."""
+        k = (site, self._key_str(key))
+        seen = self._seen.get(k, 0)
+        self._seen[k] = seen + 1
+        if seen:
+            cls = CLASS_WARM
+            self.warm_by_site[site] = self.warm_by_site.get(site, 0) + 1
+            if self._tracer is not None:
+                self._tracer.event("warm_recompile", site=site, key=k[1],
+                                   builds=seen + 1)
+        else:
+            cls = CLASS_PREWARMED if prewarmed else CLASS_COLD
+        per_site = self.by_site.setdefault(site, {})
+        per_site[cls] = per_site.get(cls, 0) + 1
+        self.compile_wall_s += float(wall_s)
+        self.total += 1
+        self.events.append({"site": site, "key": k[1], "class": cls,
+                            "wall_s": round(float(wall_s), 6)})
+        if self._counters is not None:
+            self._counters.compiles += 1
+        return cls
+
+    @property
+    def warm_total(self) -> int:
+        return sum(self.warm_by_site.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"total": self.total,
+                "warm_total": self.warm_total,
+                "compile_wall_s": round(self.compile_wall_s, 6),
+                "by_site": {s: dict(c) for s, c in sorted(self.by_site.items())},
+                "recent": list(self.events)[-8:]}
+
+
+class RooflineModel:
+    """Live tokens-per-HBM-byte roofline for the serve loop.
+
+    The engine captures ``cost_analysis()`` floats once per AOT-compiled
+    bucket (:meth:`note_cost`) and charges them per dispatch
+    (:meth:`note_dispatch`); :meth:`gauges` divides the accumulated bytes and
+    FLOPs by the profiler's measured wall time against the configured HBM
+    spec and peak-FLOPs numbers.  Dispatches of buckets that were never
+    AOT-costed (lazily-compiled shapes outside the prewarm set) are counted
+    in ``uncosted_dispatches`` so a low roofline fraction is distinguishable
+    from missing cost coverage.
+    """
+
+    def __init__(self, config=None):
+        cfg = config
+        self.hbm_gbps_spec = float(getattr(cfg, "hbm_gbps_spec", 819.0))
+        self.peak_flops = getattr(cfg, "peak_flops_per_chip", None)
+        self._costs: Dict[str, Tuple[float, float]] = {}  # key -> (flops, bytes)
+        self.flops = 0.0
+        self.bytes = 0.0
+        self.tokens = 0
+        self.dispatches = 0
+        self.uncosted_dispatches = 0
+
+    def reset(self) -> None:
+        """Zero the dispatch accumulators (timed-pass isolation, e.g. bench's
+        warm-then-measure discipline).  The per-bucket cost table survives:
+        costs are a property of the compiled bucket, not of any one pass."""
+        self.flops = 0.0
+        self.bytes = 0.0
+        self.tokens = 0
+        self.dispatches = 0
+        self.uncosted_dispatches = 0
+
+    def note_cost(self, key: Any, flops: float, bytes_accessed: float) -> None:
+        self._costs[CompileLedger._key_str(key)] = (float(flops),
+                                                    float(bytes_accessed))
+
+    def note_dispatch(self, key: Any, tokens: int) -> None:
+        self.dispatches += 1
+        self.tokens += int(tokens)
+        cost = self._costs.get(CompileLedger._key_str(key))
+        if cost is None:
+            self.uncosted_dispatches += 1
+            return
+        self.flops += cost[0]
+        self.bytes += cost[1]
+
+    def gauges(self, wall_s: float) -> Dict[str, float]:
+        """Finite gauge values; zeros until there is data to divide."""
+        out = {"serving_hbm_bytes_per_token":
+               (self.bytes / self.tokens) if self.tokens else 0.0,
+               "serving_roofline_fraction": 0.0,
+               "serving_model_flops_utilization": 0.0}
+        if wall_s > 0.0:
+            out["serving_roofline_fraction"] = (
+                self.bytes / wall_s) / (self.hbm_gbps_spec * 1e9)
+            if self.peak_flops:
+                out["serving_model_flops_utilization"] = (
+                    self.flops / wall_s) / float(self.peak_flops)
+        return out
+
+    def snapshot(self, wall_s: float = 0.0) -> Dict[str, Any]:
+        return {"costed_buckets": len(self._costs),
+                "dispatches": self.dispatches,
+                "uncosted_dispatches": self.uncosted_dispatches,
+                "tokens": self.tokens,
+                "flops": self.flops,
+                "hbm_bytes": self.bytes,
+                "gauges": {k: round(v, 9)
+                           for k, v in self.gauges(wall_s).items()}}

@@ -277,6 +277,37 @@ class TelemetryCollector:
                 and step >= start and (stop < 0 or step < stop)):
             self.start_trace()
 
+    def serve_profile_begin(self) -> None:
+        """Arm the serve-iteration capture window for one ``generate()`` call
+        (ISSUE 16 satellite): the per-generate done-flag resets so every
+        generate() can capture its own [start, stop) iteration window."""
+        self._serve_profile_done = False
+
+    def profile_serve_boundary(self, iteration: int) -> None:
+        """Drive the serve-loop capture window; call at the top of each serve
+        iteration with the CURRENT per-generate iteration index.  Same
+        [start, stop) semantics as :meth:`profile_step_boundary`, but keyed on
+        ``profile_serve_iteration_start/stop`` and re-armed per generate()."""
+        if not self.enabled:
+            return
+        start = self.config.profile_serve_iteration_start
+        stop = self.config.profile_serve_iteration_stop
+        done = getattr(self, "_serve_profile_done", False)
+        if self._tracing and stop >= 0 and iteration >= stop:
+            self.stop_trace()
+            self._serve_profile_done = True
+        if (not self._tracing and not done and start >= 0
+                and iteration >= start and (stop < 0 or iteration < stop)):
+            self.start_trace()
+
+    def serve_profile_end(self) -> None:
+        """Close any serve window still open when generate() returns — one
+        window per generate(), never a trace leaking across calls."""
+        if (self.enabled and self._tracing
+                and self.config.profile_serve_iteration_start >= 0):
+            self.stop_trace()
+            self._serve_profile_done = True
+
     def start_trace(self) -> bool:
         if self._tracing:
             return False

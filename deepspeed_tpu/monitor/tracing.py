@@ -557,6 +557,20 @@ class RequestTracer:
                              "ts": int(round(self.last_now * 1e6)),
                              "args": {k: float(v) for k, v in values.items()}})
 
+    def phase_span(self, name: str, start_s: float, dur_s: float,
+                   track: int = 0) -> None:
+        """Append a Chrome-trace COMPLETE span (``ph: "X"``) for one serve-loop
+        phase (ISSUE 16 — the StepPhaseProfiler's per-phase tracks).  Phase
+        rows live under their own pid so Perfetto groups them separately from
+        the per-request lifecycle rows; ``track`` (the phase's index) keeps
+        each phase on a stable tid/row.  A no-op unless a chrome export path
+        is configured — the serve loop pays one attribute check."""
+        if not self.config.chrome_trace_path:
+            return
+        self._chrome.append({"name": name, "ph": "X", "pid": 1, "tid": int(track),
+                             "ts": int(round(start_s * 1e6)),
+                             "dur": int(round(dur_s * 1e6)), "cat": "phase"})
+
     def write_chrome_trace(self, path: Optional[str] = None) -> Optional[str]:
         """Write buffered chrome events as a trace-event JSON file (load in
         Perfetto or chrome://tracing); returns the path, or None when neither

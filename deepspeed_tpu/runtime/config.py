@@ -228,6 +228,12 @@ class TelemetryConfig(ConfigModel):
     profile_step_start: int = Field(-1, ge=-1)
     profile_step_stop: int = Field(-1, ge=-1)
     profile_dir: str = "profiler_traces"
+    # -1 disables; [start, stop) in SERVE-LOOP iterations (ISSUE 16): opens
+    # one jax.profiler trace window per generate() call, bracketing serve
+    # iterations the way profile_step_start/stop brackets train steps, with a
+    # TraceAnnotation per serve phase while the window is open
+    profile_serve_iteration_start: int = Field(-1, ge=-1)
+    profile_serve_iteration_stop: int = Field(-1, ge=-1)
     # see_memory_usage(tag) at each steps_per_print boundary (also honors the
     # reference's top-level memory_breakdown key)
     memory_breakdown: bool = False
@@ -241,6 +247,12 @@ class TelemetryConfig(ConfigModel):
                 and self.profile_step_stop <= self.profile_step_start):
             raise ValueError(f"telemetry: profile_step_stop={self.profile_step_stop} must be "
                              f"> profile_step_start={self.profile_step_start}")
+        if (self.profile_serve_iteration_stop >= 0
+                and self.profile_serve_iteration_start >= 0
+                and self.profile_serve_iteration_stop <= self.profile_serve_iteration_start):
+            raise ValueError(
+                f"telemetry: profile_serve_iteration_stop={self.profile_serve_iteration_stop} "
+                f"must be > profile_serve_iteration_start={self.profile_serve_iteration_start}")
 
 
 class MeshConfig(ConfigModel):
@@ -532,6 +544,47 @@ class ServingTracingConfig(ConfigModel):
     flight_recorder_events: int = Field(256, ge=16)
     histogram_buckets_per_decade: int = Field(6, ge=1, le=100)
     histogram_min_s: float = Field(1e-5, gt=0.0)
+
+
+class ServingPerfConfig(ConfigModel):
+    """Serving performance observatory for the v2 ragged engine (ISSUE 16 —
+    monitor/perf.py wired through inference/v2; the serving twin of the
+    reference's training-only ``wall_clock_breakdown`` + flops profiler).
+
+    ``enabled`` turns on the StepPhaseProfiler: per-iteration phase spans
+    (admission_pump / scatter_upload / dispatch / absorb_patch / burst /
+    flush / expire / other) charged by reading the engine's injectable clock
+    at phase boundaries, accumulated into deterministic-quantile streaming
+    histograms, exported as ``serving_phase_*`` metric families, Chrome-trace
+    phase tracks and an every-``phase_budget_every``-iterations phase-budget
+    flight-recorder line, plus the live roofline gauges
+    (``serving_hbm_bytes_per_token`` / ``serving_roofline_fraction`` /
+    ``serving_model_flops_utilization``) against ``hbm_gbps_spec`` and
+    ``peak_flops_per_chip``.  Off by default: phase marks READ the clock, and
+    deadline/TTL semantics under an injected deterministic clock must not
+    shift when the observatory is toggled — with it off, the engine performs
+    zero additional clock reads, so tokens and ``ServeCounters`` are
+    byte-identical either way (the perf-smoke lane proves it).
+
+    The CompileLedger and per-bucket ``cost_analysis()`` capture are ALWAYS
+    on regardless of ``enabled`` — they add no clock reads and no device
+    work, and the ledger is the single source of truth behind
+    ``ServeCounters.compiles`` (``capture_cost_analysis`` gates only the
+    AOT-time cost read, for backends whose executables can't report costs).
+    """
+    enabled: bool = False
+    # emit a phase-budget flight-recorder line every N serve iterations
+    phase_budget_every: int = Field(50, ge=1)
+    # phase-span histogram shape; min_s is two decades below the request
+    # histograms' 1e-5 — phase spans are sub-iteration slivers
+    histogram_buckets_per_decade: int = Field(6, ge=1, le=100)
+    histogram_min_s: float = Field(1e-7, gt=0.0)
+    # HBM bandwidth spec for the roofline denominator (GB/s; 819 = v5e, the
+    # same constant BENCH's hbm_stream_fraction_of_spec divides by)
+    hbm_gbps_spec: float = Field(819.0, gt=0.0)
+    # per-chip peak FLOPs for serving MFU; None leaves the MFU gauge at 0
+    peak_flops_per_chip: Optional[float] = Field(None, gt=0.0)
+    capture_cost_analysis: bool = True
 
 
 class ServingFaultToleranceConfig(ConfigModel):
@@ -826,6 +879,9 @@ class TrainingConfig(ConfigModel):
     # copy-on-write prefix caching over the paged KV pool — same
     # dual-spelling contract as above
     serving_prefix_cache: ServingPrefixCacheConfig = Field(ServingPrefixCacheConfig)
+    # serving performance observatory (phase attribution, compile ledger,
+    # live roofline gauges) — same dual-spelling contract as above
+    serving_perf: ServingPerfConfig = Field(ServingPerfConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -1,0 +1,22 @@
+"""benchtrack — the BENCH_*.json trajectory as a regression gate (ISSUE 16).
+
+The repo commits one ``BENCH_rNN.json`` per release round: a command-wrapper
+record ``{n, cmd, rc, tail, parsed}`` whose ``tail`` holds the bench run's
+final output (when the run finished, a metrics JSON; when it timed out, log
+lines).  Until now that trajectory was a hand-read artifact; benchtrack turns
+it into a gate: ``bin/dstpu-benchdiff`` diffs two bench records (or a fresh
+run against the committed trajectory) under the per-metric direction +
+tolerance policy committed in ``benchtrack.json`` and exits 1 on regression.
+
+Pure stdlib, and scanned by dslint's ``host-sync-in-hot-path`` whole-file
+zero-device-sync contract: a bench diff must be runnable on any host (CI
+included) without touching an accelerator.
+"""
+
+from .diffcore import (VERDICT_IMPROVEMENT, VERDICT_MISSING, VERDICT_REGRESSION,
+                       VERDICT_WITHIN_BAND, diff_metrics, extract_metrics,
+                       load_bench, load_policy)
+
+__all__ = ["VERDICT_IMPROVEMENT", "VERDICT_MISSING", "VERDICT_REGRESSION",
+           "VERDICT_WITHIN_BAND", "diff_metrics", "extract_metrics",
+           "load_bench", "load_policy"]

@@ -1,0 +1,146 @@
+"""Bench-record loading + policy diff for dstpu-benchdiff.
+
+Input shapes accepted by :func:`load_bench`, most-specific first:
+
+- a plain metrics JSON object ``{"metric": value, ...}`` (a fresh ``bench.py``
+  run piped to a file);
+- the committed command-wrapper shape ``{n, cmd, rc, tail, parsed}``: when
+  ``parsed`` is a dict it wins; otherwise numeric ``"key": value`` pairs are
+  regex-extracted from ``tail`` (first occurrence wins — committed tails are
+  front-truncated, so the surviving suffix is the most-final output).  A
+  timed-out round (rc=124, log-only tail) legitimately yields ZERO metrics;
+  every policy metric then reports ``missing``, which never fails the gate —
+  a gap in the trajectory is a fact to display, not a regression.
+
+The policy (``benchtrack.json``) declares, per metric, which direction is
+good and how much movement is noise::
+
+    {"default_tolerance_pct": 5.0,
+     "metrics": {"serving_mixed_tok_s": {"direction": "higher",
+                                         "tolerance_pct": 10.0}, ...}}
+
+Only metrics named in the policy are judged: bench emits dozens of
+context numbers (params_m, bench_elapsed_s) that must not gate anything.
+"""
+
+import json
+import math
+import re
+from typing import Any, Dict, List, Optional
+
+VERDICT_REGRESSION = "regression"
+VERDICT_IMPROVEMENT = "improvement"
+VERDICT_WITHIN_BAND = "within-band"
+VERDICT_MISSING = "missing"
+
+# "key": <number> — int/float/scientific; booleans and strings are not
+# judgeable metrics and are skipped by extraction
+_METRIC_RE = re.compile(r'"([A-Za-z0-9_]+)"\s*:\s*'
+                        r'(-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)(?=[,}\s])')
+
+
+def extract_metrics(text: str) -> Dict[str, float]:
+    """Numeric ``"key": value`` pairs from (possibly truncated) JSON text,
+    first occurrence winning."""
+    out: Dict[str, float] = {}
+    for key, value in _METRIC_RE.findall(text or ""):
+        if key not in out:
+            out[key] = float(value)
+    return out
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """Load one bench record; returns {path, rc, metrics}."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object, got {type(data).__name__}")
+    rc = data.get("rc")
+    if "tail" in data or "parsed" in data:  # committed command-wrapper shape
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict):
+            metrics = {k: float(v) for k, v in _flatten(parsed).items()
+                       if isinstance(v, (int, float)) and not isinstance(v, bool)
+                       and math.isfinite(float(v))}
+        else:
+            metrics = extract_metrics(data.get("tail") or "")
+    else:  # plain metrics JSON (a fresh bench run)
+        metrics = {k: float(v) for k, v in _flatten(data).items()
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)
+                   and math.isfinite(float(v))}
+        rc = rc if isinstance(rc, int) else 0
+    return {"path": path, "rc": rc, "metrics": metrics}
+
+
+def _flatten(obj: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    """One-level-deep flatten: bench.py nests sections ({"serving": {...}});
+    leaf keys are unique across sections so the bare name stays the policy
+    spelling, with the prefixed spelling available for disambiguation."""
+    out: Dict[str, Any] = {}
+    for key, value in obj.items():
+        if isinstance(value, dict):
+            for k2, v2 in value.items():
+                if not isinstance(v2, dict):
+                    out.setdefault(k2, v2)
+                    out[f"{key}.{k2}"] = v2
+        else:
+            out.setdefault(key, value)
+    return out
+
+
+def load_policy(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        policy = json.load(fh)
+    metrics = policy.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        raise ValueError(f"{path}: policy needs a non-empty 'metrics' object")
+    for name, spec in metrics.items():
+        direction = spec.get("direction")
+        if direction not in ("higher", "lower"):
+            raise ValueError(f"{path}: metric {name}: direction must be "
+                             f"'higher' or 'lower', got {direction!r}")
+        tol = spec.get("tolerance_pct", policy.get("default_tolerance_pct", 5.0))
+        if not isinstance(tol, (int, float)) or tol < 0:
+            raise ValueError(f"{path}: metric {name}: tolerance_pct must be "
+                             f">= 0, got {tol!r}")
+    return policy
+
+
+def diff_metrics(base: Dict[str, float], cand: Dict[str, float],
+                 policy: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Judge every policy metric; returns one row per metric, policy order.
+
+    ``pct_change`` is signed toward the metric's GOOD direction (positive =
+    better), so a single ``< -tolerance`` test spells regression for both
+    higher-is-better and lower-is-better metrics.
+    """
+    default_tol = float(policy.get("default_tolerance_pct", 5.0))
+    rows: List[Dict[str, Any]] = []
+    for name, spec in policy["metrics"].items():
+        tol = float(spec.get("tolerance_pct", default_tol))
+        b, c = base.get(name), cand.get(name)
+        row: Dict[str, Any] = {"metric": name, "direction": spec["direction"],
+                               "tolerance_pct": tol, "base": b, "candidate": c}
+        if b is None or c is None:
+            row["verdict"] = VERDICT_MISSING
+            row["note"] = ("absent from both" if b is None and c is None else
+                           "absent from base" if b is None else
+                           "absent from candidate")
+        elif b == 0.0:
+            # no baseline magnitude to take a percentage of: judge by sign
+            # of movement toward the good direction, any movement is reported
+            good = (c - b) if spec["direction"] == "higher" else (b - c)
+            row["pct_change"] = None
+            row["verdict"] = (VERDICT_WITHIN_BAND if good == 0.0 else
+                              VERDICT_IMPROVEMENT if good > 0.0 else
+                              VERDICT_REGRESSION)
+        else:
+            pct = (c - b) / abs(b) * 100.0
+            if spec["direction"] == "lower":
+                pct = -pct
+            row["pct_change"] = pct
+            row["verdict"] = (VERDICT_REGRESSION if pct < -tol else
+                              VERDICT_IMPROVEMENT if pct > tol else
+                              VERDICT_WITHIN_BAND)
+        rows.append(row)
+    return rows

@@ -131,10 +131,13 @@ class HostSyncInHotPath(Rule):
                    "runtime/heartbeat.py AND the ops plane (monitor/metrics.py, "
                    "monitor/exposition.py, monitor/ops_server.py) AND the "
                    "KV-pool observability layer (inference/v2/kv_metrics.py) "
+                   "AND the serving perf observatory (monitor/perf.py) AND "
+                   "the bench regression tooling (tools/benchtrack/) "
                    "any explicit device fetch (np.asarray/np.array/device_get/"
                    "block_until_ready/.item) anywhere in the file — liveness "
-                   "stamps, metrics scrapes and pool census hooks are "
-                   "contractually zero-device-sync (float() on host config "
+                   "stamps, metrics scrapes, pool census hooks, phase/compile/"
+                   "roofline instruments and bench diffs are contractually "
+                   "zero-device-sync (float() on host config "
                    "values stays legal there; float-of-device-value isn't "
                    "statically separable from it)")
 
@@ -166,6 +169,17 @@ class HostSyncInHotPath(Rule):
     # a device fetch here would charge every step a hidden sync, so the whole
     # file is scanned with the full explicit-fetch set
     KV_METRICS_PATH_FRAGMENT = "inference/v2/kv_metrics.py"
+    # the serving perf observatory (ISSUE 16) runs INSIDE the serve loop
+    # (phase marks at every iteration, ledger records at every compile seam):
+    # it consumes only the engine's injectable clock and host ints the
+    # engine already owns — a device fetch here would charge every serve
+    # iteration a hidden sync, so the whole file is scanned
+    PERF_PATH_FRAGMENT = "monitor/perf.py"
+    # the bench regression tooling (ISSUE 16) must run on accelerator-free
+    # CI hosts: it reads committed JSON records only, so ANY device fetch
+    # (or jax/numpy dependency sneaking one in) is a contract break — the
+    # fragment is a directory, matched anywhere in the relpath
+    BENCHTRACK_PATH_FRAGMENT = "tools/benchtrack/"
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -200,6 +214,23 @@ class HostSyncInHotPath(Rule):
                 "observatory/forecaster are contractually zero-device-sync: "
                 "they consume host ints the allocator and ragged manager "
                 "already own, and their hooks run inside the serve loop")
+            return
+        if relpath.endswith(self.PERF_PATH_FRAGMENT):
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in monitor/perf.py — the serving perf observatory (phase "
+                "profiler / compile ledger / roofline) is contractually "
+                "zero-device-sync: it consumes only the engine's injectable "
+                "clock and host floats, and its hooks run inside the serve "
+                "loop at every iteration and compile seam")
+            return
+        if self.BENCHTRACK_PATH_FRAGMENT in relpath:
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in tools/benchtrack/ — bench regression diffs are "
+                "contractually zero-device-sync: they run on accelerator-free "
+                "CI hosts over committed JSON records, so a device fetch "
+                "here breaks the pure-stdlib contract")
             return
         in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent

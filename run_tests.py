@@ -1009,6 +1009,146 @@ def serving_recovery_smoke():
     return 0
 
 
+def perf_smoke():
+    """CI smoke for the serving perf observatory (ISSUE 16 acceptance): a
+    3-wave mixed-arrival serve with the observatory ON must (a) fill EVERY
+    phase family (admission_pump .. other) with spans that sum to the
+    measured iteration wall, (b) report ZERO warm recompiles across all
+    three waves (the steady-state no-recompile guarantee, runtime twin of
+    dslint's recompile-risk rule), (c) carry full roofline cost coverage
+    (no uncosted dispatches) with finite gauges, (d) strict-parse the new
+    serving_phase/compiles/recompiles/roofline families off a live /metrics
+    scrape, and (e) add ZERO cost — tokens and the fastpath ``ServeCounters``
+    byte-identical with the observatory off."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.ops_server import scrape
+    from deepspeed_tpu.monitor.perf import PHASES
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(0)
+    # three arrival waves of mixed prompt lengths: wave 2/3 revisit wave 1's
+    # compiled buckets, so any recompile is a warm one the ledger must flag
+    waves = [[rng.integers(1, 128, int(n)).tolist()
+              for n in rng.integers(4, 16, 5)] for _ in range(3)]
+
+    on = InferenceEngineV2(llama, cfg, params,
+                           config={"dtype": "float32",
+                                   "serving_tracing": {"enabled": True},
+                                   "serving_perf": {"enabled": True},
+                                   "ops_server": {"enabled": True,
+                                                  "refresh_interval_s": 0.0}},
+                           **kw)
+    off = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32"}, **kw)
+    toks_on = [on.generate(w, max_new_tokens=8) for w in waves]
+    toks_off = [off.generate(w, max_new_tokens=8) for w in waves]
+
+    # ---- (a) every phase family non-empty, spans sum to the wall
+    prof = on.phase_profiler
+    empty = [p for p in PHASES if prof.hists[p].count == 0]
+    assert not empty, f"phase families never sampled: {empty}"
+    assert abs(sum(prof.totals.values()) - prof.wall_s) < 1e-6, \
+        "phase spans do not sum to the iteration wall"
+
+    # ---- (b) zero warm recompiles over the 3-wave scenario
+    led = on.ledger.snapshot()
+    assert led["warm_total"] == 0, f"warm recompiles in steady state: {led}"
+    assert on.counters.compiles == led["total"], \
+        "ledger/counter compile attribution drift"
+
+    # ---- (c) full roofline cost coverage, finite gauges
+    roof = on.health()["perf"]["roofline"]
+    assert roof["uncosted_dispatches"] == 0, roof
+    assert roof["costed_buckets"] > 0 and roof["hbm_bytes"] > 0
+    for name, v in roof["gauges"].items():
+        assert v == v and abs(v) != float("inf"), f"{name} not finite: {v}"
+
+    # ---- (d) the new families strict-parse off a live /metrics scrape
+    fams = parse_exposition(scrape(on.ops.url("/metrics")))
+    phase_samples = fams["dstpu_serving_phase_seconds"]["samples"]
+    phases_seen = {l.get("phase") for _, l, _ in phase_samples if l.get("phase")}
+    assert set(PHASES) <= phases_seen, f"missing phase series: {set(PHASES) - phases_seen}"
+    assert any(l.get("site") == "fwd"
+               for _, l, _ in fams["dstpu_serving_compiles_total"]["samples"])
+    recomp = fams["dstpu_serving_recompiles_total"]["samples"]
+    assert recomp and all(v == 0.0 for _, _, v in recomp), recomp
+    for name in ("dstpu_serving_roofline_fraction",
+                 "dstpu_serving_hbm_bytes_per_token"):
+        assert name in fams, f"missing family {name}"
+
+    # ---- (e) byte-identity: observatory adds zero cost
+    assert toks_on == toks_off, "observatory changed the served tokens"
+    c_on, c_off = on.counters.snapshot(), off.counters.snapshot()
+    assert c_on == c_off, \
+        f"observatory disturbed the host-link counters: {c_on} vs {c_off}"
+
+    on.close_ops()
+    print(json.dumps({"perf_smoke": "ok", "waves": len(waves),
+                      "iterations": prof.iterations,
+                      "phases": {p: prof.hists[p].count for p in PHASES},
+                      "compiles": led["total"], "warm_recompiles": 0,
+                      "costed_buckets": roof["costed_buckets"],
+                      "roofline_fraction": roof["gauges"]["serving_roofline_fraction"]}))
+    return 0
+
+
+def run_bench_diff_lane():
+    """bench regression gate (ISSUE 16): the committed BENCH_r04->r05 pair
+    must pass (timed-out r04 carries zero metrics -> all-missing verdicts,
+    never a failure), and an injected-regression fixture must exit 1 — both
+    via the standalone bin/dstpu-benchdiff CLI (same loading discipline as
+    the lint lane: works even when the library is broken at import time)."""
+    import os
+    import tempfile
+    t0 = time.time()
+    root = os.path.dirname(os.path.abspath(__file__))
+    cli = os.path.join(root, "bin", "dstpu-benchdiff")
+    committed = subprocess.run(
+        [sys.executable, cli, os.path.join(root, "BENCH_r04.json"),
+         os.path.join(root, "BENCH_r05.json"),
+         "--policy", os.path.join(root, "benchtrack.json")],
+        capture_output=True, text=True)
+    # injected regression: candidate = r05's metrics with the serving
+    # throughput cut 30% — must trip the gate
+    from deepspeed_tpu.tools.benchtrack.diffcore import load_bench
+    metrics = dict(load_bench(os.path.join(root, "BENCH_r05.json"))["metrics"])
+    degraded = dict(metrics)
+    degraded["serving_mixed_tok_s"] = metrics.get("serving_mixed_tok_s", 100.0) * 0.7
+    tmp = tempfile.mkdtemp(prefix="dstpu_benchdiff_")
+    base_p = os.path.join(tmp, "base.json")
+    cand_p = os.path.join(tmp, "degraded.json")
+    json.dump(metrics, open(base_p, "w"))
+    json.dump(degraded, open(cand_p, "w"))
+    injected = subprocess.run(
+        [sys.executable, cli, base_p, cand_p,
+         "--policy", os.path.join(root, "benchtrack.json")],
+        capture_output=True, text=True)
+    dt = time.time() - t0
+    ok = committed.returncode == 0 and injected.returncode == 1
+    tail = (f"committed pair rc={committed.returncode} (want 0), "
+            f"injected regression rc={injected.returncode} (want 1)")
+    print(f"[bench_diff] {tail}  ({dt:.0f}s)")
+    if not ok:
+        print(committed.stdout[-2000:])
+        print(injected.stdout[-2000:])
+        print(committed.stderr[-1000:], file=sys.stderr)
+        print(injected.stderr[-1000:], file=sys.stderr)
+    return {"name": "bench_diff", "rc": 0 if ok else 1, "seconds": round(dt, 1),
+            "summary": tail}
+
+
 def run_smoke_lane(name: str, flag: str):
     """Run one of the smoke entry points as its own recorded lane (subprocess:
     each smoke pins its own env and must not contaminate the pytest lanes)."""
@@ -1134,6 +1274,8 @@ def main():
              run_smoke_lane("prefix_cache_smoke", "--prefix-cache-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
+             run_smoke_lane("perf_smoke", "--perf-smoke"),
+             run_bench_diff_lane(),
              run_drift_families_lane(),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
     out = {"lanes": lanes, "ok": all(l["rc"] == 0 for l in lanes)}
@@ -1164,6 +1306,10 @@ if __name__ == "__main__":
         sys.exit(serving_recovery_smoke())
     if "--elastic-smoke" in sys.argv:
         sys.exit(elastic_smoke())
+    if "--perf-smoke" in sys.argv:
+        sys.exit(perf_smoke())
+    if "--bench-diff" in sys.argv:
+        sys.exit(run_bench_diff_lane()["rc"])
     if "--lint" in sys.argv:
         sys.exit(run_lint_lane()["rc"])
     if "--drift-families" in sys.argv:

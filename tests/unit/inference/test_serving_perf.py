@@ -1,0 +1,473 @@
+"""Serving performance observatory suite (ISSUE 16): FakeClock-exact phase
+attribution, compile-ledger classes (prewarmed/cold/warm), live roofline
+gauges, zero-perturbation byte-identity (tokens + ServeCounters with the
+observatory on vs off, fastpath AND reference paths), Chrome-trace phase
+tracks, the serve-iteration jax.profiler window, and the benchdiff regression
+gate — all on the CPU backend with deterministic clocks."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.monitor.exposition import parse_exposition, render
+from deepspeed_tpu.monitor.metrics import MetricsRegistry, populate_from_engine
+from deepspeed_tpu.monitor.perf import (CLASS_COLD, CLASS_PREWARMED, CLASS_WARM,
+                                        PHASES, CompileLedger, RooflineModel,
+                                        StepPhaseProfiler)
+from deepspeed_tpu.monitor.telemetry import TelemetryCollector
+from deepspeed_tpu.runtime.config import ServingPerfConfig, TelemetryConfig
+from deepspeed_tpu.tools.benchtrack.cli import main as benchdiff_main
+from deepspeed_tpu.tools.benchtrack.diffcore import (VERDICT_IMPROVEMENT,
+                                                     VERDICT_MISSING,
+                                                     VERDICT_REGRESSION,
+                                                     VERDICT_WITHIN_BAND,
+                                                     diff_metrics, extract_metrics,
+                                                     load_bench)
+from tests.unit.fault_injection_serving import FakeClock
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+class _TracerStub:
+    """Records phase_span/event calls; stands in for RequestTracer."""
+
+    def __init__(self):
+        self.spans = []
+        self.events = []
+
+    def phase_span(self, name, start_s, dur_s, track=0):
+        self.spans.append((name, start_s, dur_s, track))
+
+    def event(self, name, **fields):
+        self.events.append((name, fields))
+
+
+# -------------------------------------------------------- phase profiler unit
+def _profiler(tick=0.01, *, tracer=None, **cfg_kw):
+    cfg = ServingPerfConfig(enabled=True, **cfg_kw)
+    clock = FakeClock(tick=tick)
+    return StepPhaseProfiler(cfg, clock=clock, tracer=tracer), clock
+
+
+def test_profiler_exact_attribution_and_residual_to_other():
+    prof, _ = _profiler(tick=0.01)
+    prof.begin_iteration()
+    prof.mark("admission_pump")   # 1 tick
+    prof.mark("dispatch")         # 1 tick
+    prof.mark("dispatch")         # accumulates: 2 ticks total
+    prof.end_iteration()          # residual tick -> "other"
+    # FakeClock advances 0.01 per read: every span is an exact clock delta
+    assert prof.totals["admission_pump"] == pytest.approx(0.01)
+    assert prof.totals["dispatch"] == pytest.approx(0.02)
+    assert prof.totals["other"] > 0.0
+    assert prof.iterations == 1
+    # the defining invariant: spans sum to the iteration wall EXACTLY
+    assert sum(prof.totals.values()) == prof.wall_s
+
+
+def test_profiler_spans_sum_to_wall_across_iterations():
+    prof, _ = _profiler(tick=0.003)
+    for i in range(7):
+        prof.begin_iteration()
+        for phase in PHASES[:1 + (i % 4)]:
+            prof.mark(phase)
+        prof.end_iteration()
+    assert prof.iterations == 7
+    assert sum(prof.totals.values()) == pytest.approx(prof.wall_s, abs=1e-12)
+
+
+def test_profiler_quantiles_fakeclock_exact():
+    prof, _ = _profiler(tick=0.02)
+    for _ in range(4):
+        prof.begin_iteration()
+        prof.mark("burst")  # every sample is exactly one 0.02 tick
+        prof.end_iteration()
+    h = prof.hists["burst"]
+    assert h.count == 4
+    # deterministic quantiles: the answering bucket's representative, not an
+    # interpolation — identical across reruns
+    assert h.quantile(0.5) == h.representative(h._index(0.02))
+    assert h.quantile(0.99) == h.representative(h._index(0.02))
+    snap = prof.snapshot()
+    assert snap["phases"]["burst"]["count"] == 4
+    assert snap["phases"]["burst"]["p50"] == h.quantile(0.5)
+
+
+def test_profiler_disabled_never_reads_clock():
+    cfg = ServingPerfConfig(enabled=False)
+    clock = FakeClock(tick=1.0)
+    prof = StepPhaseProfiler(cfg, clock=clock)
+    prof.begin_iteration()
+    prof.mark("dispatch")
+    prof.end_iteration()
+    assert clock.calls == 0, "disabled observatory must not consume the clock"
+    assert prof.iterations == 0 and prof.snapshot()["phases"] == {}
+
+
+def test_profiler_marks_outside_iteration_ignored_without_clock_reads():
+    prof, clock = _profiler(tick=0.01)
+    prof.mark("expire")  # engine's _expire_live also runs outside _serve_loop
+    assert clock.calls == 0 and prof.totals["expire"] == 0.0
+
+
+def test_profiler_zero_tick_clock_still_fills_families():
+    # a zero-tick FakeClock makes every span 0.0 — samples must still land
+    # (underflow bucket) so phase families are non-empty in smoke checks
+    prof, _ = _profiler(tick=0.0)
+    prof.begin_iteration()
+    prof.mark("flush")
+    prof.end_iteration()
+    assert prof.hists["flush"].count == 1
+    assert prof.hists["flush"].quantile(0.5) == 0.0
+
+
+def test_profiler_phase_budget_line_and_chrome_spans():
+    tracer = _TracerStub()
+    prof, _ = _profiler(tick=0.01, tracer=tracer, phase_budget_every=2)
+    for _ in range(5):
+        prof.begin_iteration()
+        prof.mark("dispatch")
+        prof.end_iteration()
+    budgets = [f for n, f in tracer.events if n == "phase_budget"]
+    assert len(budgets) == 2  # after iterations 2 and 4
+    assert budgets[0]["iters"] == 2 and budgets[0]["wall_s"] > 0
+    assert budgets[0]["top"] in PHASES
+    # one Chrome span per marked phase per iteration, on the phase's track
+    dispatch_spans = [s for s in tracer.spans if s[0] == "dispatch"]
+    assert len(dispatch_spans) == 5
+    assert all(s[3] == PHASES.index("dispatch") for s in dispatch_spans)
+
+
+# -------------------------------------------------------- compile ledger unit
+class _Counters:
+    def __init__(self):
+        self.compiles = 0
+
+
+def test_ledger_classes_warm_detection_and_counter_parity():
+    counters, tracer = _Counters(), _TracerStub()
+    led = CompileLedger(counters, tracer=tracer)
+    assert led.record("fwd", (1, 8, 4), prewarmed=True) == CLASS_PREWARMED
+    assert led.record("fwd", (2, 8, 4)) == CLASS_COLD
+    assert led.record("scatter", "sig-a") == CLASS_COLD
+    # same (site, key) again: a warm recompile — the runtime event dslint's
+    # recompile-risk rule predicts statically
+    assert led.record("fwd", (2, 8, 4)) == CLASS_WARM
+    assert led.by_site["fwd"] == {CLASS_PREWARMED: 1, CLASS_COLD: 1, CLASS_WARM: 1}
+    assert led.warm_by_site == {"fwd": 1} and led.warm_total == 1
+    assert counters.compiles == led.total == 4  # exactly one bump per record
+    warm_events = [f for n, f in tracer.events if n == "warm_recompile"]
+    assert warm_events == [{"site": "fwd", "key": "(2, 8, 4)", "builds": 2}]
+    snap = led.snapshot()
+    assert snap["warm_total"] == 1 and snap["recent"][-1]["class"] == CLASS_WARM
+
+
+def test_ledger_same_key_different_sites_not_warm():
+    led = CompileLedger()
+    assert led.record("pick", (4, 8)) == CLASS_COLD
+    assert led.record("burst", (4, 8)) == CLASS_COLD  # different seam, not warm
+    assert led.warm_total == 0
+
+
+def test_ledger_compile_wall_accumulates():
+    led = CompileLedger()
+    led.record("fwd", (1, 1, 1), wall_s=0.25, prewarmed=True)
+    led.record("fwd", (2, 1, 1), wall_s=0.5, prewarmed=True)
+    assert led.compile_wall_s == pytest.approx(0.75)
+
+
+# ------------------------------------------------------------- roofline unit
+def test_roofline_gauges_finite_and_uncosted_tracking():
+    roof = RooflineModel(ServingPerfConfig(hbm_gbps_spec=100.0,
+                                           peak_flops_per_chip=1e12))
+    roof.note_cost((1, 8, 4), flops=2e9, bytes_accessed=1e9)
+    roof.note_dispatch((1, 8, 4), tokens=8)
+    roof.note_dispatch((9, 9, 9), tokens=2)  # never costed
+    assert roof.uncosted_dispatches == 1 and roof.tokens == 10
+    g = roof.gauges(wall_s=1.0)
+    assert g["serving_hbm_bytes_per_token"] == pytest.approx(1e9 / 10)
+    assert g["serving_roofline_fraction"] == pytest.approx(1e9 / (100.0 * 1e9))
+    assert g["serving_model_flops_utilization"] == pytest.approx(2e9 / 1e12)
+    # no wall time yet -> zeros, never NaN/inf
+    zeros = roof.gauges(wall_s=0.0)
+    assert zeros["serving_roofline_fraction"] == 0.0
+    assert all(v == v and abs(v) != float("inf") for v in zeros.values())
+
+
+def test_roofline_reset_zeros_accumulators_but_keeps_cost_table():
+    # bench's warm-then-measure discipline: the warm pass's dispatches must
+    # not leak into the timed pass's gauges, but the per-bucket cost table
+    # (a property of the compiled bucket, not of any one pass) survives
+    roof = RooflineModel(ServingPerfConfig(hbm_gbps_spec=100.0))
+    roof.note_cost((1, 8, 4), flops=2e9, bytes_accessed=1e9)
+    roof.note_dispatch((1, 8, 4), tokens=8)
+    roof.note_dispatch((9, 9, 9), tokens=2)
+    roof.reset()
+    assert (roof.bytes, roof.flops, roof.tokens, roof.dispatches,
+            roof.uncosted_dispatches) == (0.0, 0.0, 0, 0, 0)
+    assert roof.gauges(wall_s=1.0)["serving_roofline_fraction"] == 0.0
+    # a post-reset dispatch of the previously-costed bucket is still costed
+    roof.note_dispatch((1, 8, 4), tokens=4)
+    assert roof.uncosted_dispatches == 0 and roof.bytes == pytest.approx(1e9)
+    assert roof.gauges(wall_s=1.0)["serving_hbm_bytes_per_token"] == (
+        pytest.approx(1e9 / 4))
+
+
+# --------------------------------------------------------- engine integration
+def _tiny_engine(**kw):
+    cfg = llama.LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2))
+    defaults = dict(config={"dtype": "float32"},
+                    num_blocks=32, block_size=8, max_blocks_per_seq=8,
+                    token_budget=32, max_seqs_per_step=4)
+    defaults.update(kw)
+    return InferenceEngineV2(llama, cfg, params, **defaults)
+
+_PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [8, 9], [10, 11, 12]]
+
+
+def test_engine_phase_families_fill_and_sum_to_wall():
+    eng = _tiny_engine(clock=FakeClock(tick=0.001),
+                       config={"dtype": "float32",
+                               "serving_perf": {"enabled": True}})
+    eng.generate(_PROMPTS, max_new_tokens=6)
+    prof = eng.phase_profiler
+    assert prof.iterations > 0
+    # the serve loop touches every family in a mixed prefill/decode run
+    for phase in ("admission_pump", "scatter_upload", "dispatch",
+                  "absorb_patch", "expire", "other"):
+        assert prof.hists[phase].count > 0, f"phase {phase} never sampled"
+    assert sum(prof.totals.values()) == pytest.approx(prof.wall_s, abs=1e-9)
+    snap = eng.health()["perf"]
+    assert snap["phases"]["dispatch"]["p50"] is not None
+    assert snap["compile_ledger"]["warm_total"] == 0
+    assert snap["roofline"]["gauges"]["serving_hbm_bytes_per_token"] > 0.0
+
+
+@pytest.mark.parametrize("fastpath", [True, False])
+def test_tokens_and_counters_byte_identical_observatory_on_vs_off(fastpath):
+    """The zero-perturbation acceptance: enabling the observatory changes no
+    token and no ServeCounters value, on both the fastpath and the reference
+    (fastpath-off) serve paths."""
+    def run(perf_on):
+        eng = _tiny_engine(
+            clock=FakeClock(tick=0.001),
+            config={"dtype": "float32",
+                    "serving_fastpath": {"enabled": fastpath},
+                    "serving_perf": {"enabled": perf_on}})
+        toks = eng.generate(_PROMPTS, max_new_tokens=6)
+        return toks, eng.counters.snapshot()
+
+    toks_off, counters_off = run(False)
+    toks_on, counters_on = run(True)
+    assert toks_on == toks_off
+    assert counters_on == counters_off
+
+
+def test_engine_ledger_attributes_prewarm_and_traffic():
+    eng = _tiny_engine()
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    led = eng.ledger
+    assert led.warm_total == 0, "steady-state serve must not recompile"
+    fwd = led.by_site.get("fwd", {})
+    assert fwd.get(CLASS_PREWARMED, 0) > 0, "prewarm buckets unattributed"
+    # ledger is the single source of truth for the compiles counter
+    assert eng.counters.compiles == led.total
+
+
+def test_engine_forced_recompile_classified_warm():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_tracing": {"enabled": True},
+                               "serving_perf": {"enabled": True}})
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    assert eng.ledger.warm_total == 0
+    eng._fwd_cache.clear()          # forced: every cached program rebuilds
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    # the cache held fwd buckets AND pick/burst programs: all rebuild warm
+    assert eng.ledger.warm_total > 0
+    assert eng.ledger.by_site["fwd"].get(CLASS_WARM, 0) > 0
+    assert sum(eng.ledger.warm_by_site.values()) == eng.ledger.warm_total
+    tail = [e for e in eng.tracer.recorder.tail() if e["event"] == "warm_recompile"]
+    assert tail and "fwd" in {e["site"] for e in tail}
+
+
+def test_engine_roofline_full_cost_coverage():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_perf": {"enabled": True}})
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    roof = eng.health()["perf"]["roofline"]
+    assert roof["costed_buckets"] > 0
+    assert roof["uncosted_dispatches"] == 0, \
+        "every dispatched fwd bucket must carry cost_analysis numbers"
+    assert roof["hbm_bytes"] > 0.0 and roof["flops"] > 0.0
+    for v in roof["gauges"].values():
+        assert v == v and abs(v) != float("inf")
+
+
+def test_metrics_families_for_observatory():
+    eng = _tiny_engine(config={"dtype": "float32",
+                               "serving_perf": {"enabled": True}})
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    reg = MetricsRegistry()
+    populate_from_engine(reg, eng)
+    fams = parse_exposition(render(reg))  # strict-parse clean
+    phase_hist = fams["dstpu_serving_phase_seconds"]
+    phases_seen = {dict(labels)["phase"] for _, labels, _ in phase_hist["samples"]
+                   if dict(labels).get("phase")}
+    assert {"dispatch", "admission_pump"} <= phases_seen
+    compile_rows = {tuple(sorted(dict(labels).items()))
+                    for _, labels, _ in fams["dstpu_serving_compiles_total"]["samples"]}
+    assert any(("site", "fwd") in row for row in compile_rows)
+    recompiles = fams["dstpu_serving_recompiles_total"]["samples"]
+    assert recompiles and all(v == 0.0 for _, _, v in recompiles)
+    assert "dstpu_serving_roofline_fraction" in fams
+    assert "dstpu_serving_hbm_bytes_per_token" in fams
+
+
+def test_chrome_trace_contains_phase_tracks(tmp_path):
+    trace_path = str(tmp_path / "phases.trace.json")
+    eng = _tiny_engine(clock=FakeClock(tick=0.001),
+                       config={"dtype": "float32",
+                               "serving_tracing": {"enabled": True,
+                                                   "chrome_trace_path": trace_path},
+                               "serving_perf": {"enabled": True}})
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    events = json.load(open(trace_path))
+    if isinstance(events, dict):
+        events = events["traceEvents"]
+    phase_events = [e for e in events if e.get("cat") == "phase"]
+    assert phase_events, "no phase track events in the Chrome trace"
+    assert {e["name"] for e in phase_events} <= set(PHASES)
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in phase_events)
+
+
+def _patch_trace_stubs(collector, monkeypatch):
+    """Replace the jax.profiler start/stop with call-recording stubs that
+    keep the collector's ``_tracing`` bookkeeping honest."""
+    calls = []
+
+    def start():
+        calls.append("start")
+        collector._tracing = True
+        return True
+
+    def stop():
+        calls.append("stop")
+        collector._tracing = False
+
+    monkeypatch.setattr(collector, "start_trace", start)
+    monkeypatch.setattr(collector, "stop_trace", stop)
+    return calls
+
+
+def test_serve_profiler_window_one_per_generate(monkeypatch):
+    """Satellite: profile_serve_iteration_start/stop drive one jax.profiler
+    window per generate(), [start, stop) on the per-generate iteration index."""
+    collector = TelemetryCollector(config=TelemetryConfig(
+        enabled=True,
+        profile_serve_iteration_start=1, profile_serve_iteration_stop=3))
+    calls = _patch_trace_stubs(collector, monkeypatch)
+    eng = _tiny_engine(telemetry=collector)
+    eng.generate(_PROMPTS, max_new_tokens=6)
+    assert calls == ["start", "stop"], calls
+    eng.generate(_PROMPTS, max_new_tokens=6)  # window re-arms per generate()
+    assert calls == ["start", "stop"] * 2, calls
+
+
+def test_serve_profiler_window_closed_at_generate_end(monkeypatch):
+    # stop index beyond the loop's iteration count: serve_profile_end must
+    # close the window rather than leak the trace across generate() calls
+    collector = TelemetryCollector(config=TelemetryConfig(
+        enabled=True,
+        profile_serve_iteration_start=0, profile_serve_iteration_stop=10_000))
+    calls = _patch_trace_stubs(collector, monkeypatch)
+    eng = _tiny_engine(telemetry=collector)
+    eng.generate(_PROMPTS, max_new_tokens=4)
+    assert calls == ["start", "stop"], calls
+
+
+def test_config_rejects_stop_before_start():
+    with pytest.raises(Exception):
+        TelemetryConfig(profile_serve_iteration_start=5,
+                        profile_serve_iteration_stop=3)
+
+
+# ------------------------------------------------------------------ benchdiff
+_POLICY = {"default_tolerance_pct": 5.0,
+           "metrics": {"tok_s": {"direction": "higher", "tolerance_pct": 10.0},
+                       "p95_ms": {"direction": "lower", "tolerance_pct": 10.0},
+                       "ghost": {"direction": "higher"}}}
+
+
+def test_diff_metrics_all_four_verdicts():
+    base = {"tok_s": 100.0, "p95_ms": 50.0}
+    cand = {"tok_s": 80.0,   # -20% on higher-is-better: regression
+            "p95_ms": 40.0}  # -20% on lower-is-better: improvement
+    rows = {r["metric"]: r for r in diff_metrics(base, cand, _POLICY)}
+    assert rows["tok_s"]["verdict"] == VERDICT_REGRESSION
+    assert rows["tok_s"]["pct_change"] == pytest.approx(-20.0)
+    assert rows["p95_ms"]["verdict"] == VERDICT_IMPROVEMENT
+    assert rows["p95_ms"]["pct_change"] == pytest.approx(20.0)
+    assert rows["ghost"]["verdict"] == VERDICT_MISSING
+    within = diff_metrics({"tok_s": 100.0}, {"tok_s": 95.0}, _POLICY)[0]
+    assert within["verdict"] == VERDICT_WITHIN_BAND  # -5% inside the 10% band
+
+
+def test_diff_metrics_regression_on_lower_is_better():
+    rows = diff_metrics({"p95_ms": 50.0}, {"p95_ms": 60.0}, _POLICY)
+    p95 = [r for r in rows if r["metric"] == "p95_ms"][0]
+    assert p95["verdict"] == VERDICT_REGRESSION  # +20% latency
+
+
+def test_extract_metrics_from_truncated_tail():
+    tail = '"p95_ms": 12.5, "tok_s": 900.0, "name": "x", "tok_s": 1.0}'
+    m = extract_metrics(tail)
+    assert m == {"p95_ms": 12.5, "tok_s": 900.0}  # first occurrence wins
+
+
+def _write(path, obj):
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def test_benchdiff_cli_exit_codes(tmp_path, capsys):
+    policy = _write(tmp_path / "benchtrack.json", _POLICY)
+    base = _write(tmp_path / "base.json", {"tok_s": 100.0, "p95_ms": 50.0})
+    regressed = _write(tmp_path / "regressed.json", {"tok_s": 70.0, "p95_ms": 50.0})
+    improved = _write(tmp_path / "improved.json", {"tok_s": 130.0, "p95_ms": 40.0})
+    assert benchdiff_main([base, regressed, "--policy", policy]) == 1
+    assert "regression" in capsys.readouterr().out
+    assert benchdiff_main([base, improved, "--policy", policy]) == 0
+    capsys.readouterr()  # drop the text table before the JSON-mode call
+    assert benchdiff_main([base, improved, "--policy", policy, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] and payload["regressions"] == 0
+    # missing metrics never fail the gate
+    empty = _write(tmp_path / "empty.json", {})
+    assert benchdiff_main([empty, improved, "--policy", policy]) == 0
+    # malformed inputs are a usage error, not a crash or a false verdict
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert benchdiff_main([str(bad), improved, "--policy", policy]) == 2
+    assert benchdiff_main([base, improved, "--policy",
+                           _write(tmp_path / "pol2.json", {"metrics": {}})]) == 2
+
+
+def test_benchdiff_wrapper_shape_and_committed_pair():
+    r04 = os.path.join(REPO_ROOT, "BENCH_r04.json")
+    r05 = os.path.join(REPO_ROOT, "BENCH_r05.json")
+    if not (os.path.exists(r04) and os.path.exists(r05)):
+        pytest.skip("committed BENCH records not present")
+    rec = load_bench(r05)
+    assert rec["metrics"].get("serving_mixed_tok_s", 0) > 0
+    # r04 timed out (rc=124, log-only tail): zero metrics, all-missing
+    # verdicts, and the committed-trajectory gate stays green
+    assert load_bench(r04)["metrics"] == {}
+    assert benchdiff_main([r04, r05, "--policy",
+                           os.path.join(REPO_ROOT, "benchtrack.json")]) == 0

@@ -214,6 +214,88 @@ class TestHostSyncInHotPath:
         # hot-path scan applies (asarray + float), each flagged exactly once
         assert rules_of(out) == ["host-sync-in-hot-path"] * 2
 
+    # ---- serving perf observatory whole-file scan (ISSUE 16): phase marks
+    # run at every serve iteration and ledger records at every compile seam —
+    # a device fetch anywhere in monitor/perf.py is a finding, same contract
+    # (and same scan) as runtime/heartbeat.py and the ops plane
+    def test_perf_observatory_flags_fetch_in_any_function(self):
+        out = run("""
+            import numpy as np
+
+            class StepPhaseProfiler:
+                def mark(self, phase, dev):
+                    self.totals[phase] += float(np.asarray(dev))
+            """, self.RULE, filename="deepspeed_tpu/monitor/perf.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "zero-device-sync" in out[0].message
+
+    def test_perf_observatory_flags_block_until_ready_and_module_level(self):
+        out = run("""
+            import jax
+
+            PROBE = jax.device_get(0)
+
+            class CompileLedger:
+                def record(self, site, key, compiled):
+                    compiled.block_until_ready()
+            """, self.RULE, filename="deepspeed_tpu/monitor/perf.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"] * 2
+
+    def test_perf_observatory_allows_host_clock_and_float_math(self):
+        # the observatory consumes the engine's injectable clock (a host
+        # callable) plus host floats: clock reads, float() math and dict
+        # bookkeeping must all stay clean
+        out = run("""
+            class StepPhaseProfiler:
+                def mark(self, phase):
+                    now = float(self._clock())
+                    self.totals[phase] = self.totals.get(phase, 0.0) + (
+                        now - self._t_mark)
+                    self._t_mark = now
+            """, self.RULE, filename="deepspeed_tpu/monitor/perf.py")
+        assert out == []
+
+    # ---- benchtrack whole-file scan (ISSUE 16): bench diffs run on
+    # accelerator-free CI hosts over committed JSON — directory fragment,
+    # so every file under tools/benchtrack/ is covered
+    @pytest.mark.parametrize(
+        "fname", ["deepspeed_tpu/tools/benchtrack/diffcore.py",
+                  "deepspeed_tpu/tools/benchtrack/cli.py"])
+    def test_benchtrack_flags_fetch_in_any_function(self, fname):
+        out = run("""
+            import numpy as np
+
+            def load_bench(path):
+                return np.asarray(open(path).read())
+            """, self.RULE, filename=fname)
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "zero-device-sync" in out[0].message
+
+    def test_benchtrack_allows_pure_stdlib_diff_math(self):
+        out = run("""
+            import json
+
+            def diff_metrics(base, cand):
+                rows = []
+                for name, b in base.items():
+                    c = cand.get(name)
+                    if c is not None and b:
+                        rows.append((name, (c - b) / abs(b) * 100.0))
+                return json.dumps(rows)
+            """, self.RULE, filename="deepspeed_tpu/tools/benchtrack/diffcore.py")
+        assert out == []
+
+    def test_tools_outside_benchtrack_not_whole_file_scanned(self):
+        # other tools keep the default scoping — the directory fragment
+        # covers exactly tools/benchtrack/
+        out = run("""
+            import numpy as np
+
+            def collect(dev):
+                return np.asarray(dev)
+            """, self.RULE, filename="deepspeed_tpu/tools/reportgen.py")
+        assert out == []
+
 
 # ------------------------------------------------------ traced-control-flow
 class TestTracedControlFlow:
