@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -136,6 +136,15 @@ elastic-smoke:
 # live /metrics scrape, and tokens + ServeCounters byte-identical vs off
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --perf-smoke
+
+# serving fleet (ISSUE 17): 3 in-process supervised replicas behind the
+# health-gated FleetRouter; one replica crash-injected mid-decode past its
+# restart budget — journaled in-flight work must migrate to a healthy
+# replica byte-identically, the merged /metrics stays strict-parseable and
+# monotone across the failover, prefix affinity realizes KV hits on the
+# home replica, and zero requests are lost or orphaned
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --fleet-smoke
 
 # bench regression gate (ISSUE 16): bin/dstpu-benchdiff under the committed
 # benchtrack.json policy — the committed BENCH_r04->r05 pair must pass and an

@@ -1053,6 +1053,120 @@ def measure_serving_shared_prefix(on_tpu: bool):
     return out
 
 
+def measure_serving_fleet(on_tpu: bool):
+    """Fleet serving (ISSUE 17): two supervised replicas behind the
+    health-gated ``FleetRouter`` on a shared-header workload.  Leg one is the
+    HEALTHY fleet — ``serving_fleet_tok_s`` is the gated throughput of a full
+    serve fanned out by load + prefix affinity.  Leg two is the failover
+    price tag: one replica is crash-injected past its restart budget
+    mid-serve, and the reported wall covers drain + journal transplant +
+    byte-identical continuation on the survivor (correctness of that
+    continuation is CI-gated by ``make fleet-smoke``; here it is timed)."""
+    import tempfile
+
+    import jax
+
+    from deepspeed_tpu.inference.v2 import FleetRouter, InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_req, header_len, tail_len, max_new = 16, 192, 16, 24
+        num_blocks, block_size, maxb, budget, max_seqs = 2048, 32, 64, 512, 16
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+        n_req, header_len, tail_len, max_new = 6, 8, 4, 8
+        num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 8, 32, 8
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    header = rng.integers(1, cfg.vocab_size, header_len).tolist()
+    prompts = ([header + rng.integers(1, cfg.vocab_size, tail_len).tolist()
+                for _ in range(n_req // 2)]
+               + [rng.integers(1, cfg.vocab_size, int(n)).tolist()
+                  for n in rng.integers(4, 16, n_req - n_req // 2)])
+
+    fault = {"armed": False}
+
+    def _factory(index):
+        def build():
+            eng = InferenceEngineV2(
+                llama, cfg, params,
+                config={"dtype": "bfloat16" if on_tpu else "float32"},
+                num_blocks=num_blocks, block_size=block_size,
+                max_blocks_per_seq=maxb, token_budget=budget,
+                max_seqs_per_step=max_seqs)
+            if index == 0 and fault["armed"]:
+                # die after one clamped burst: the emitted prefix is
+                # journaled, the stream is mid-flight, every restart
+                # generation dies the same way until the budget exhausts
+                events = {"n": 0}
+
+                def _productive():
+                    events["n"] += 1
+                    if events["n"] >= 2:
+                        raise RuntimeError("bench: injected fleet crash")
+
+                real_burst = eng.decode_burst
+
+                def burst(k, *args, **kwargs):
+                    out = real_burst(min(int(k), 2), *args, **kwargs)
+                    if out:
+                        _productive()
+                    return out
+
+                real_dispatch = eng._dispatch_step
+
+                def dispatch(*args, **kwargs):
+                    out = real_dispatch(*args, **kwargs)
+                    if out is not None:
+                        _productive()
+                    return out
+
+                eng.decode_burst = burst
+                eng._dispatch_step = dispatch
+            return eng
+        return build
+
+    tmp = tempfile.mkdtemp(prefix="dstpu_bench_fleet_")
+    router = FleetRouter(
+        [_factory(r) for r in range(2)], journal_dir=tmp,
+        config={"replicas": 2, "affinity_blocks": 1, "health_stale_s": 600.0},
+        ft_config={"enabled": True, "max_restarts": 1, "fsync_every": 0},
+        block_size=block_size)
+
+    # warm wave: compile every replica's buckets outside the timed window
+    router.serve(prompts[:2] + prompts[-2:],
+                 uids=[100000 + i for i in range(4)], max_new_tokens=max_new)
+
+    t0 = time.perf_counter()
+    out = router.serve(prompts, uids=list(range(n_req)),
+                       max_new_tokens=max_new)
+    dt = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) - len(p) for r, p in zip(out, prompts)
+                 if r.ok and r.tokens)
+
+    fault["armed"] = True
+    t1 = time.perf_counter()
+    out2 = router.serve(prompts, uids=list(range(n_req, 2 * n_req)),
+                        max_new_tokens=max_new)
+    failover_s = time.perf_counter() - t1
+    health = router.health()
+    res = {"serving_fleet_tok_s": round(tokens / max(dt, 1e-9), 1),
+           "serving_fleet_requests": n_req,
+           "serving_fleet_replicas": 2,
+           "serving_fleet_affinity_routed": router.affinity_routed_total,
+           "serving_fleet_failover_s": round(failover_s, 2),
+           "serving_fleet_failover_ok": all(r.ok for r in out2),
+           "serving_fleet_migrations": router.migrations_total,
+           "serving_fleet_migrated_requests": router.migrated_requests_total,
+           "serving_fleet_lost": router.lost_total,
+           "serving_fleet_healthy_replicas": health["healthy_replicas"]}
+    router.close()
+    return res
+
+
 def _ops_refresh_cost(eng, rounds: int = 20):
     """Median wall cost of one ops cache refresh on a live engine, plus the
     family count the endpoint would expose — the operator-facing price tag
@@ -1187,6 +1301,7 @@ def main():
                                                        50 if on_tpu else 5)),
         ("serving_mixed", 70, lambda: measure_serving_mixed(on_tpu)),
         ("shared_prefix", 45, lambda: measure_serving_shared_prefix(on_tpu)),
+        ("serving_fleet", 60, lambda: measure_serving_fleet(on_tpu)),
         ("ring",    90,  lambda: measure_ring(on_tpu)),
         ("big",     55,  lambda: measure_training_big(on_tpu)),
         ("infinity", 0,  None),  # placeholder — budget set from remaining budget;

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
                               ServingFastpathConfig,
                               ServingFaultToleranceConfig,
+                              ServingFleetConfig,
                               ServingPerfConfig,
                               ServingPrefixCacheConfig,
                               ServingResilienceConfig, ServingTracingConfig)
@@ -75,6 +76,11 @@ class InferenceConfig(ConfigModel):
     # (section defined in runtime/config.py so train+serve configs share one
     # spelling)
     serving_perf: ServingPerfConfig = Field(ServingPerfConfig)
+    # fleet front-end over N supervised replicas: health-gated least-loaded
+    # routing, prefix-affinity homing, shed backoff, journaled failover
+    # migration — inference/v2/router.py (section defined in
+    # runtime/config.py so train+serve configs share one spelling)
+    serving_fleet: ServingFleetConfig = Field(ServingFleetConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

@@ -11,6 +11,7 @@ from .kv_metrics import (BlockCensus, CapacityForecaster, CensusInvariantError,
 from .ragged_manager import (EmptyPromptError, PrefixCache, PrefixEntry,
                              RaggedStateManager, SequenceDescriptor,
                              UnknownSequenceError)
+from .router import FleetRouter, ReplicaHandle
 from .scheduler import ScheduledChunk, SplitFuseScheduler
 from .supervisor import (RecoveryPlan, ServeSpec, ServingSupervisor,
                          plan_recovery, recover_and_serve)

@@ -55,6 +55,10 @@ class RequestResult:
     retryable: bool = False
     queue_wait_s: float = 0.0
     preemptions: int = 0
+    # structured backpressure (ISSUE 17): the shed's retry_after_s hint,
+    # carried through so a fleet router (or client) can back off for the
+    # admission door's own pressure estimate instead of guessing
+    retry_after_s: Optional[float] = None
 
     @property
     def ok(self) -> bool:
@@ -63,14 +67,26 @@ class RequestResult:
 
 @dataclasses.dataclass(frozen=True)
 class ShedReason:
-    """Structured admission rejection, decided before any KV allocation."""
+    """Structured admission rejection, decided before any KV allocation.
+
+    ``retry_after_s`` (retryable sheds only) is the admission door's own
+    estimate of how long the pressure that caused the shed takes to clear —
+    queue sheds scale with the configured depth cap, KV-pressure sheds with
+    the utilization overshoot.  It turns every shed site into structured
+    backpressure a fleet router can honor instead of re-hammering the same
+    replica on a generic exponential clock.  None on fatal sheds (no wait
+    will ever make an over-cap prompt fit).
+    """
     code: str      # empty_prompt | prompt_over_cap | queue_full | kv_pressure
     detail: str
     retryable: bool
+    retry_after_s: Optional[float] = None
 
     def __str__(self):
         kind = "retryable" if self.retryable else "fatal"
-        return f"[{self.code}/{kind}] {self.detail}"
+        hint = (f"; retry in ~{self.retry_after_s:.2f}s"
+                if self.retry_after_s is not None else "")
+        return f"[{self.code}/{kind}] {self.detail}{hint}"
 
 
 class ServingStalledError(RuntimeError):
@@ -150,6 +166,11 @@ class AdmissionQueue:
         self._seq = 0  # FIFO tiebreak within a priority class
         self.submitted_total = 0
         self.shed_total = 0
+        # per-code shed accounting (ISSUE 17): lifetime counts plus the last
+        # retry_after_s hint issued per code — exported as the labeled
+        # Prometheus shed families next to the unlabeled shed_total
+        self.shed_by_code: Dict[str, int] = {}
+        self.last_retry_after: Dict[str, float] = {}
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -167,14 +188,22 @@ class AdmissionQueue:
                               f"KV cap of {token_cap} tokens", retryable=False)
         depth_cap = self.config.max_queue_depth
         if depth_cap and len(self._heap) >= depth_cap:
+            # retry hint ~ time to drain a full queue: scale with the depth
+            # cap (a deeper queue takes longer to clear), clamped to a
+            # [0.05s, 2s] band so the hint is always a sane client backoff
             return ShedReason("queue_full",
                               f"admission queue at max_queue_depth={depth_cap}",
-                              retryable=True)
+                              retryable=True,
+                              retry_after_s=min(2.0, max(0.05, 0.025 * depth_cap)))
         shed_at = self.config.shed_kv_utilization
         if kv_utilization is not None and shed_at < 1.0 and kv_utilization >= shed_at:
+            # retry hint grows with the overshoot past the shed threshold: a
+            # pool 1% over the line frees a block soon; one pinned at 100%
+            # needs requests to retire first
             return ShedReason("kv_pressure",
                               f"KV utilization {kv_utilization:.3f} >= shed threshold "
-                              f"{shed_at} (pool pressure)", retryable=True)
+                              f"{shed_at} (pool pressure)", retryable=True,
+                              retry_after_s=min(2.0, 0.1 + 4.0 * (kv_utilization - shed_at)))
         return None
 
     # --------------------------------------------------------------- intake
@@ -199,6 +228,9 @@ class AdmissionQueue:
                                   token_cap=token_cap)
         if reason is not None:
             self.shed_total += 1
+            self.shed_by_code[reason.code] = self.shed_by_code.get(reason.code, 0) + 1
+            if reason.retry_after_s is not None:
+                self.last_retry_after[reason.code] = reason.retry_after_s
             if self.tracer is not None:
                 if self.tracer.enabled:
                     # sheds never reach the ticket stamp below, so span

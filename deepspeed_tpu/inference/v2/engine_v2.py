@@ -266,6 +266,10 @@ class InferenceEngineV2:
         self._inflight: Optional[DeferredTokens] = None
         self._table_width = 0
         self._table_slack = 0
+        # health() freshness stamp: advanced at state-change boundaries
+        # (wave-boundary / serve-end _refresh_kv), NOT per health() call —
+        # the cached /healthz snapshot must mirror health() verbatim
+        self._health_generated_at = self._clock()
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{block_size} "
                  f"budget={token_budget} dtype={self.config.dtype} tp={self.tp} "
                  f"fastpath={'on' if self.fastpath.enabled else 'off'}", ranks=[0])
@@ -694,6 +698,7 @@ class InferenceEngineV2:
         sample when a trace export is configured.  Pure host arithmetic over
         ints the engine already owns — zero device syncs, and no effect on
         ``ServeCounters`` (the kv-obs smoke pins byte-identity on vs off)."""
+        self._health_generated_at = self._clock()
         if self.kv_obs is None:
             return
         free = self.manager.allocator.free_blocks
@@ -1217,7 +1222,8 @@ class InferenceEngineV2:
                     if strict:
                         raise RuntimeError(f"request {uid} shed: {shed}")
                     results[uid] = RequestResult(uid=uid, status=SHED, reason=str(shed),
-                                                 retryable=shed.retryable)
+                                                 retryable=shed.retryable,
+                                                 retry_after_s=shed.retry_after_s)
                 elif self.journal is not None:
                     # the effective TTL (what admission just stamped) rides
                     # the admit record, with a wall-clock stamp so recovery
@@ -1848,6 +1854,13 @@ class InferenceEngineV2:
         training engine's telemetry record): pool state, queue depth, and the
         lifetime resilience counters."""
         return {
+            # freshness stamp (ISSUE 17) from the INJECTABLE clock, advanced
+            # at serve/wave boundaries: a fleet router compares it against its
+            # own reading of the same clock and treats a snapshot past its
+            # staleness horizon as unhealthy — a frozen replica's last-good
+            # gauges must not attract traffic.  Stamped at refresh (not per
+            # call) so the cached /healthz snapshot mirrors health() exactly
+            "generated_at": self._health_generated_at,
             "live_seqs": len(self.manager.live_uids()),
             "queue_depth": len(self.admission),
             "free_blocks": self.manager.allocator.free_blocks,

@@ -259,13 +259,20 @@ class RequestJournal:
     def record_admit(self, uid: int, prompt: Iterable[int], *, priority: int = 0,
                      ttl_s: Optional[float] = None, max_new_tokens: int = 0,
                      eos_token_id: Optional[int] = None, greedy: bool = True,
-                     prefix_len: int = 0) -> None:
+                     prefix_len: int = 0,
+                     admit_wall: Optional[float] = None) -> None:
         uid = int(uid)
         self.watched.add(uid)
+        # ``admit_wall`` transplants an entry between journals (fleet failover
+        # migration): the ORIGINAL wall stamp rides along with the original
+        # ttl_s so the deadline keeps ticking on the request's own clock —
+        # the ttl/wall pairing contract replay documents.  Fresh admits stamp
+        # their own wall.
+        wall = self._wall() if admit_wall is None else float(admit_wall)
         # strict mode fsyncs admits eagerly: losing one loses the request
         self._emit({"t": "admit", "uid": uid, "prompt": [int(t) for t in prompt],
                     "priority": int(priority), "ttl_s": ttl_s,
-                    "wall": self._wall(), "max_new_tokens": int(max_new_tokens),
+                    "wall": wall, "max_new_tokens": int(max_new_tokens),
                     "eos": eos_token_id, "greedy": bool(greedy),
                     "key": [self.seed, uid], "prefix_len": int(prefix_len)},
                    durable=True)

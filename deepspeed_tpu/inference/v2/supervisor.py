@@ -485,6 +485,76 @@ class ServingSupervisor:
             generation += 1
         return [results[u] for u in uid_list]
 
+    def serve_specs(self, specs: Sequence[ServeSpec], *,
+                    max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
+                    greedy: bool = True,
+                    on_generation: Optional[Callable[[Any, int], None]] = None
+                    ) -> Tuple[Dict[int, RequestResult], bool]:
+        """In-process crash-restart serve that STOPS at budget exhaustion
+        instead of degrading to drain-only: returns ``(results, exhausted)``.
+
+        This is the fleet router's failover seam (ISSUE 17).  ``serve()``
+        owns the single-replica endgame — drain-only final attempt, then
+        finalize-as-failed — because a lone engine has nowhere else to send
+        work.  A router DOES: on ``exhausted=True`` the journal still holds
+        every unresolved request's emitted prefix and original wall-clock
+        admit stamp, ready to migrate to a healthy replica's journal for
+        byte-identical ``serve_recovered`` continuation.  ``on_generation``
+        (called with each generation's engine, crashed or clean, before its
+        journal closes) is the router's metrics-absorption hook — per-
+        generation registry snapshots keep the fleet endpoint's counter
+        carry exact across restarts."""
+        if self.engine_factory is None:
+            raise ValueError("serve_specs needs an engine_factory")
+        results: Dict[int, RequestResult] = {}
+        exhausted = False
+        generation = self.generations  # resume numbering across serve calls
+        while any(s.uid not in results for s in specs):
+            engine = None
+            todo = [s for s in specs if s.uid not in results]
+            try:
+                engine = self._build_engine(generation)
+                got = recover_and_serve(engine, todo,
+                                        max_new_tokens=max_new_tokens,
+                                        eos_token_id=eos_token_id,
+                                        greedy=greedy, drain=False,
+                                        wall_clock=self._wall)
+                self.recovered_requests_total += \
+                    engine.ft_stats["recovered_requests_total"]
+                results.update({u: r for u, r in got.items()
+                                if u in {s.uid for s in todo}})
+                self._event("run_complete", generation=generation,
+                            served=len(got))
+                break
+            except Exception as exc:  # the crash seam: ANY engine failure
+                self.restarts_total += 1
+                self._note_failure(f"{type(exc).__name__}: {exc}")
+                if self._budget_exhausted():
+                    self.degraded = True
+                    exhausted = True
+                    self._event("budget_exhausted",
+                                restarts=self.restarts_total,
+                                unresolved=len([s for s in specs
+                                                if s.uid not in results]))
+                    logger.warning("serving supervisor: restart budget "
+                                   "exhausted — handing journaled work back "
+                                   "for migration")
+            finally:
+                self.generations = generation + 1
+                if engine is not None and on_generation is not None:
+                    # metrics hook BEFORE the journal closes: the router
+                    # absorbs this generation's final engine state (health +
+                    # registry) under this generation's stamp
+                    on_generation(engine, generation)
+                if engine is not None and engine.journal is not None:
+                    engine.journal.close()
+                self._ops_absorb_engine(engine, generation)
+                self._refresh_ops(force=True)
+            if exhausted:
+                break
+            generation += 1
+        return results, exhausted
+
     def _finalize_failed(self, results: Dict[int, RequestResult],
                          todo: Sequence[ServeSpec]) -> None:
         """Drain failed too: every unresolved request becomes a structured

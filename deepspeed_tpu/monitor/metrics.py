@@ -377,6 +377,19 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
     reg.set_counter(f"{reg.namespace}_serving_shed_total",
                     engine.admission.shed_total,
                     help_text="requests load-shed at the admission door")
+    # structured backpressure (ISSUE 17): per-code shed counters plus the
+    # door's own latest retry_after_s estimate — a fleet router (or client)
+    # backs off for the hinted interval instead of guessing
+    for code, count in sorted(engine.admission.shed_by_code.items()):
+        reg.set_counter(f"{reg.namespace}_serving_shed_reason_total", count,
+                        labels={"code": code},
+                        help_text="requests shed, by structured reason code "
+                                  "(sums to serving_shed_total)")
+    for code, hint in sorted(engine.admission.last_retry_after.items()):
+        reg.set_gauge(f"{reg.namespace}_serving_shed_retry_after_seconds",
+                      hint, labels={"code": code},
+                      help_text="latest retry_after_s backpressure hint "
+                                "attached to a shed of this code")
     reg.set_counter(f"{reg.namespace}_serving_preempted_total",
                     engine.scheduler.preempted_total,
                     help_text="KV-pressure preemptions (incl. exhausted evictions)")
@@ -683,6 +696,57 @@ def populate_from_supervisor(reg: MetricsRegistry, supervisor) -> None:
     reg.set_gauge(f"{reg.namespace}_supervisor_degraded",
                   1.0 if supervisor.degraded else 0.0,
                   help_text="1 when the restart budget degraded to drain-only")
+
+
+def populate_from_router(reg: MetricsRegistry, router) -> None:
+    """FleetRouter → registry: the fleet-level view no single replica can
+    see — routing distribution, prefix-affinity effectiveness, shed
+    re-routes and backoff, failover migrations, and the zero-lost-requests
+    invariant — merged into the same registry the FleetAggregator already
+    filled with replica-carried counters (ISSUE 17)."""
+    ns = reg.namespace
+    for index, count in enumerate(router.routed_total):
+        reg.set_counter(f"{ns}_router_routed_total", count,
+                        labels={"replica": str(index)},
+                        help_text="requests routed, by destination replica")
+    reg.set_counter(f"{ns}_router_affinity_routed_total",
+                    router.affinity_routed_total,
+                    help_text="requests routed to their prefix-affinity home")
+    reg.set_counter(f"{ns}_router_affinity_overridden_total",
+                    router.affinity_overridden_total,
+                    help_text="requests whose affinity home was unhealthy or "
+                              "overloaded (fell back to least-loaded)")
+    reg.set_counter(f"{ns}_router_reroutes_total", router.reroutes_total,
+                    help_text="retryable sheds re-routed to another replica")
+    reg.set_counter(f"{ns}_router_backoff_seconds_total",
+                    router.backoff_seconds_total,
+                    help_text="cumulative shed-backoff wait")
+    reg.set_counter(f"{ns}_router_migrations_total", router.migrations_total,
+                    help_text="replicas drained after restart-budget "
+                              "exhaustion (journaled work migrated)")
+    reg.set_counter(f"{ns}_router_migrated_requests_total",
+                    router.migrated_requests_total,
+                    help_text="in-flight journal entries transplanted to a "
+                              "healthy replica")
+    reg.set_counter(f"{ns}_router_adopted_from_journal_total",
+                    router.adopted_from_journal_total,
+                    help_text="terminals adopted from a drained replica's "
+                              "journal during migration")
+    reg.set_counter(f"{ns}_router_lost_total", router.lost_total,
+                    help_text="requests finalized failed with NO replica "
+                              "available — staying at zero is the fleet's "
+                              "durability invariant")
+    reg.set_gauge(f"{ns}_router_replicas", len(router.replicas),
+                  help_text="fleet size")
+    reg.set_gauge(f"{ns}_router_healthy_replicas",
+                  len(router.healthy_indices()),
+                  help_text="replicas currently routable and health-fresh")
+    for replica in router.replicas:
+        reg.set_gauge(f"{ns}_router_replica_drained",
+                      1.0 if replica.drained else 0.0,
+                      labels={"replica": str(replica.index)},
+                      help_text="1 once the replica's restart budget "
+                                "exhausted and its work migrated away")
 
 
 def populate_from_agent(reg: MetricsRegistry, agent,

@@ -655,6 +655,58 @@ class ServingFaultToleranceConfig(ConfigModel):
                              "which exports DSTPU_HEARTBEAT_DIR)")
 
 
+class ServingFleetConfig(ConfigModel):
+    """Fleet front-end over N supervised serving replicas
+    (inference/v2/router.py — the horizontal-scale layer over the
+    single-engine stack: Orca/vLLM-class deployments put a health-gated
+    router in front of replicated engines; no reference section, the
+    reference delegates fleet routing to external serving infra).
+
+    ``replicas`` sizes the fleet the router fronts.  Admission is
+    least-loaded-healthy: the router scores each replica from its last
+    ``health()`` snapshot (queue depth weighted by ``queue_weight``, KV
+    utilization by ``kv_weight``) and steers AWAY from any replica whose
+    ``CapacityForecaster`` predicts KV exhaustion within
+    ``exhaustion_steer_steps`` serve steps — pressure-avoidance before the
+    replica ever sheds.  A snapshot older than ``health_stale_s`` (per its
+    ``generated_at`` stamp) marks the replica unhealthy: a frozen replica's
+    last-good gauges must not keep attracting traffic (the hang-worker
+    failure mode).
+
+    ``affinity_blocks`` > 0 routes shared-header prompts by prefix
+    affinity: the chained token-block hash (the PR-13 ``PrefixCache``
+    keying) of the prompt's leading full blocks picks a stable home
+    replica, so one header's PrefixCache tree stays hot on one replica
+    instead of lukewarm on all of them.  0 disables affinity (pure
+    least-loaded).
+
+    A retryable per-replica shed is never surfaced to the caller while
+    budget remains: the router re-routes it up to ``max_reroutes`` times
+    with exponential backoff (``backoff_base_s`` doubling per attempt,
+    capped at ``backoff_max_s``), honoring the shed's ``retry_after_s``
+    hint when the admission door supplied one.
+
+    Failover: each replica keeps its own journal under its own
+    ``ServingSupervisor`` (restart budget per ``serving_fault_tolerance``);
+    a replica that exhausts its budget is drained and its journaled
+    in-flight work MIGRATES to a healthy replica — emitted prefixes are
+    copied into the target's journal with their ORIGINAL wall-clock admit
+    stamps, so ``serve_recovered`` continues them byte-identically on
+    their original TTL clocks.  Zero lost requests.
+    """
+    enabled: bool = False
+    replicas: int = Field(2, ge=1)
+    health_stale_s: float = Field(5.0, gt=0.0)
+    affinity_blocks: int = Field(1, ge=0)  # full prompt blocks hashed; 0 = off
+    max_reroutes: int = Field(3, ge=0)
+    backoff_base_s: float = Field(0.05, ge=0.0)
+    backoff_max_s: float = Field(2.0, gt=0.0)
+    exhaustion_steer_steps: float = Field(32.0, gt=0.0)
+    queue_weight: float = Field(1.0, ge=0.0)
+    kv_weight: float = Field(8.0, ge=0.0)
+    namespace: str = "dstpu"
+
+
 class KVObservabilityConfig(ConfigModel):
     """Block-level observability over the paged KV pool for the v2 ragged
     engine (inference/v2/kv_metrics.py — no reference section: the CUDA
@@ -882,6 +934,10 @@ class TrainingConfig(ConfigModel):
     # serving performance observatory (phase attribution, compile ledger,
     # live roofline gauges) — same dual-spelling contract as above
     serving_perf: ServingPerfConfig = Field(ServingPerfConfig)
+    # fleet front-end over N supervised replicas (health-gated routing,
+    # prefix affinity, journaled failover migration) — same dual-spelling
+    # contract as above
+    serving_fleet: ServingFleetConfig = Field(ServingFleetConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False

@@ -180,6 +180,12 @@ class HostSyncInHotPath(Rule):
     # (or jax/numpy dependency sneaking one in) is a contract break — the
     # fragment is a directory, matched anywhere in the relpath
     BENCHTRACK_PATH_FRAGMENT = "tools/benchtrack/"
+    # the fleet router (ISSUE 17) holds the same whole-file promise, stricter
+    # than the per-function v2 scan that would otherwise apply: routing and
+    # failover decisions read health dicts and journal files only — a device
+    # fetch in the front-end would stall EVERY request's admission, so the
+    # full explicit-fetch set (plus .item()) applies module-wide
+    ROUTER_PATH_FRAGMENT = "inference/v2/router.py"
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -231,6 +237,15 @@ class HostSyncInHotPath(Rule):
                 "contractually zero-device-sync: they run on accelerator-free "
                 "CI hosts over committed JSON records, so a device fetch "
                 "here breaks the pure-stdlib contract")
+            return
+        if relpath.endswith(self.ROUTER_PATH_FRAGMENT):
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in inference/v2/router.py — the fleet router is "
+                "contractually zero-device-sync: routing, health gating, and "
+                "journal-transplant failover read host dicts and journal "
+                "files only, or every request's admission stalls on a device "
+                "round-trip")
             return
         in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent

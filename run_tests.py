@@ -1104,6 +1104,221 @@ def perf_smoke():
     return 0
 
 
+def fleet_smoke():
+    """CI smoke for the serving fleet (ISSUE 17 acceptance): three in-process
+    supervised replicas behind the health-gated ``FleetRouter`` on a mixed
+    workload with shared prompt headers; one replica is crash-injected
+    mid-decode (the crash worker's count-to-N idiom, in-process) until its
+    restart budget exhausts.  The router must drain it and migrate its
+    journaled in-flight work to a healthy replica such that (a) every request
+    reaches a terminal ``ok`` result, (b) migrated token streams are
+    byte-identical to an uninterrupted seeded single-engine run, (c) the
+    merged /metrics text strict-parses and every fleet counter is monotone
+    across the failover, (d) prefix affinity realizes actual KV prefix hits
+    on the home replica, and (e) zero requests are orphaned: every admit
+    journaled anywhere is terminal somewhere, and ``lost_total == 0``."""
+    import os
+    import signal
+    import tempfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    from deepspeed_tpu.inference.v2 import FleetRouter, InferenceEngineV2
+    from deepspeed_tpu.inference.v2.journal import replay_journal
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from tests.unit.inference.serving_crash_worker import workload
+
+    def _deadline(signum, frame):
+        raise TimeoutError("fleet_smoke exceeded its 600s deadline — fleet "
+                           "failover or shed re-routing may have regressed "
+                           "into a wedge")
+
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(600)
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+
+    # mixed workload: the crash worker's seeded prompts plus two requests
+    # sharing one FULL 8-token header block — with block_size=8 and
+    # affinity_blocks=1 that header is exactly the affinity home key AND a
+    # realizable prefix-cache block
+    header = [7, 11, 13, 17, 19, 23, 29, 31]
+    base = workload()
+    mixed = base[:3] + [header + [41, 43, 47], header + [53, 59]] + base[3:]
+    wave1, wave2 = mixed[:5], mixed[5:]
+
+    # uninterrupted seeded reference: the byte-identity oracle (greedy decode
+    # is per-sequence deterministic, so batch composition cannot matter)
+    ref = InferenceEngineV2(llama, cfg, params, config={"dtype": "float32"},
+                            **kw)
+    ref_out = ref.generate(mixed, max_new_tokens=8)
+
+    # the in-process analog of the crash worker's flush-count fault: once
+    # armed, replica 0's engines die right AFTER their first non-empty decode
+    # burst of every generation — the burst epilogue has just journaled and
+    # flushed the emitted tokens, so the crash leaves durable in-flight
+    # prefixes with no terminals (exactly what failover must migrate)
+    fault = {"armed": False}
+
+    def _arm_crash(engine):
+        # count "productive" serve events (a dispatched step or a non-empty
+        # burst) and die on the third — by then at least one step's tokens
+        # have been absorbed into the journal (the burst epilogue and the
+        # supervisor's close-on-crash both flush), so every generation dies
+        # with durable in-flight prefixes and no terminals
+        events = {"n": 0}
+
+        def _productive():
+            events["n"] += 1
+            if events["n"] >= 2:
+                raise RuntimeError("fleet_smoke: injected mid-decode crash")
+
+        real_burst = engine.decode_burst
+
+        def burst(k, *args, **kwargs):
+            # clamp the fused window so the crash lands MID-stream: an
+            # unclamped first burst can emit the whole remaining stream,
+            # leaving the restart generation nothing to do (complete journal
+            # streams are adopted, the budget never exhausts, and there is
+            # no failover to exercise)
+            out = real_burst(min(int(k), 2), *args, **kwargs)
+            if out:
+                _productive()
+            return out
+
+        real_dispatch = engine._dispatch_step
+
+        def dispatch(*args, **kwargs):
+            out = real_dispatch(*args, **kwargs)
+            if out is not None:
+                _productive()
+            return out
+
+        engine.decode_burst = burst
+        engine._dispatch_step = dispatch
+        return engine
+
+    def _factory(index):
+        def build():
+            eng = InferenceEngineV2(llama, cfg, params,
+                                    config={"dtype": "float32"}, **kw)
+            if index == 0 and fault["armed"]:
+                _arm_crash(eng)
+            return eng
+        return build
+
+    tmp = tempfile.mkdtemp(prefix="dstpu_fleet_smoke_")
+    # health_stale_s is wide open here: on CPU a single XLA compile takes
+    # longer than the 5s production horizon, so real-clock staleness would
+    # gate replicas arbitrarily (the staleness gate itself is unit-tested
+    # with fake clocks in test_serving_fleet.py)
+    router = FleetRouter([_factory(r) for r in range(3)], journal_dir=tmp,
+                         config={"replicas": 3, "affinity_blocks": 1,
+                                 "health_stale_s": 600.0},
+                         ft_config={"enabled": True, "max_restarts": 1,
+                                    "fsync_every": 1},
+                         block_size=8)
+    home = router._affinity_home(header + [41, 43, 47])
+
+    # ---- wave 1: all replicas healthy; the shared-header pair homes
+    out1 = router.serve(wave1, uids=list(range(len(wave1))),
+                        max_new_tokens=8)
+    for uid, r in enumerate(out1):
+        assert r.status == "ok", (uid, r.status, r.reason)
+        assert r.tokens == ref_out[uid], \
+            f"uid {uid}: fleet stream diverged from the uninterrupted run"
+    assert router.affinity_routed_total >= 2, router.affinity_routed_total
+
+    scrape1 = parse_exposition(router.metrics_text())
+    hits = [(labels, v) for name, labels, v
+            in scrape1["dstpu_serving_kv_prefix_hits_total"]["samples"]
+            if labels.get("rank") == str(home)]
+    assert hits and max(v for _, v in hits) > 0, \
+        f"no realized prefix hits on home replica {home}: {hits}"
+
+    def _counters(families):
+        flat = {}
+        for fam, body in families.items():
+            if body["type"] != "counter":
+                continue
+            for name, labels, value in body["samples"]:
+                flat[(name, tuple(sorted(labels.items())))] = value
+        return flat
+
+    before = _counters(scrape1)
+
+    # ---- wave 2: arm the fault; replica 0 (least-loaded tie, lowest index)
+    # takes the non-affinity traffic, crashes past its budget, and the router
+    # must migrate its journaled in-flight work to a healthy replica
+    fault["armed"] = True
+    out2 = router.serve(wave2, uids=list(range(len(wave1), len(mixed))),
+                        max_new_tokens=8)
+    for i, r in enumerate(out2):
+        uid = len(wave1) + i
+        assert r.status == "ok", (uid, r.status, r.reason)
+        assert r.tokens == ref_out[uid], \
+            f"uid {uid}: migrated stream diverged from the uninterrupted run"
+
+    assert router.migrations_total == 1, router.migrations_total
+    assert router.migrated_requests_total >= 1, router.migrated_requests_total
+    assert router.lost_total == 0, router.lost_total
+    assert router.replicas[0].drained
+    migrations = [e for e in router.recorder.tail() if e["event"] == "migrate"]
+    inflight = [e for e in migrations if e["emitted"] > 0]
+    assert inflight, \
+        "no migrated request carried a journaled emitted prefix — the " \
+        "failover exercised only fresh re-admission, not true continuation"
+
+    fleet_health = router.health()
+    assert fleet_health["healthy_replicas"] == 2, fleet_health
+
+    # ---- merged metrics stay strict-parseable and monotone across failover
+    scrape2 = parse_exposition(router.metrics_text())
+    after = _counters(scrape2)
+    regressed = {k: (before[k], after[k]) for k in before
+                 if k in after and after[k] < before[k] - 1e-9}
+    assert not regressed, \
+        f"fleet counters went backwards across the failover: {regressed}"
+    assert after[("dstpu_router_migrations_total", ())] == 1.0
+
+    # ---- zero orphans: every uid admitted in ANY journal is terminal in
+    # SOME journal (the drained replica's in-flight entries must have
+    # reached terminals on their migration targets)
+    admitted, terminal = set(), set()
+    for replica in router.replicas:
+        if not os.path.exists(replica.journal_path):
+            continue
+        state = replay_journal(replica.journal_path, truncate=False)
+        admitted.update(state.entries)
+        terminal.update(u for u, e in state.entries.items() if e.done)
+    orphans = sorted(admitted - terminal)
+    assert not orphans, f"journaled requests with no terminal anywhere: {orphans}"
+
+    # ---- the drained replica is routed around, not resurrected
+    routed0 = router.routed_total[0]
+    out3 = router.serve([[3, 1, 4, 1, 5]], uids=[99], max_new_tokens=4)
+    assert out3[0].status == "ok", out3[0]
+    assert router.routed_total[0] == routed0, \
+        "post-drain traffic reached the drained replica"
+
+    router.close()
+    signal.alarm(0)
+    print(json.dumps({"fleet_smoke": "ok", "requests": len(mixed) + 1,
+                      "home_replica": home,
+                      "affinity_routed": router.affinity_routed_total,
+                      "prefix_hits_on_home": max(v for _, v in hits),
+                      "migrations": router.migrations_total,
+                      "migrated_requests": router.migrated_requests_total,
+                      "migrated_with_prefix": len(inflight),
+                      "lost": router.lost_total, "orphans": 0}))
+    return 0
+
+
 def run_bench_diff_lane():
     """bench regression gate (ISSUE 16): the committed BENCH_r04->r05 pair
     must pass (timed-out r04 carries zero metrics -> all-missing verdicts,
@@ -1275,6 +1490,7 @@ def main():
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
              run_smoke_lane("elastic_smoke", "--elastic-smoke"),
              run_smoke_lane("perf_smoke", "--perf-smoke"),
+             run_smoke_lane("fleet_smoke", "--fleet-smoke"),
              run_bench_diff_lane(),
              run_drift_families_lane(),
              run_lane("default", []), run_lane("slow", ["-m", "slow"])]
@@ -1308,6 +1524,8 @@ if __name__ == "__main__":
         sys.exit(elastic_smoke())
     if "--perf-smoke" in sys.argv:
         sys.exit(perf_smoke())
+    if "--fleet-smoke" in sys.argv:
+        sys.exit(fleet_smoke())
     if "--bench-diff" in sys.argv:
         sys.exit(run_bench_diff_lane()["rc"])
     if "--lint" in sys.argv:

@@ -147,6 +147,7 @@ def test_mistral_v2_ragged_consistent_and_windowed():
 
 
 # ------------------------------------------------------------- mixtral v2
+@pytest.mark.slow
 def test_mixtral_v2_ragged_generation():
     """Mixtral (MoE) serves through v2: ragged == solo generation, finite."""
     from deepspeed_tpu.models import mixtral

@@ -146,6 +146,7 @@ def test_invariant_names_leaked_block():
     assert exc.value.block == leaked and "leaked" in str(exc.value)
 
 
+@pytest.mark.slow
 def test_invariant_holds_through_fault_injected_serve():
     """The smoke's core assertion as a unit test: 25% probabilistic allocator
     failures drive every alloc/free/preempt/burst-rollback path, and the
@@ -398,6 +399,7 @@ def test_engine_pressure_event_lands_in_flight_recorder():
 
 
 # -------------------------------------------------- zero-added-cost guarantee
+@pytest.mark.slow
 def test_serve_counters_byte_identical_kv_obs_on_vs_off():
     import numpy as np
     rng = np.random.default_rng(0)

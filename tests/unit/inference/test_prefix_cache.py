@@ -328,6 +328,7 @@ def test_realized_savings_match_observatory_counterfactual():
     assert pc["deferrals_total"] > 0  # same-wave sharing rode the deferral
 
 
+@pytest.mark.slow
 def test_no_sharing_workload_costs_nothing():
     """Acceptance: on a workload with nothing to share the cache must be
     free — fastpath ServeCounters byte-identical cache on vs off (<=1 host
@@ -363,6 +364,7 @@ def test_shared_prefix_serve_under_allocator_faults():
     assert eng.manager.allocator.free_blocks == 39
 
 
+@pytest.mark.slow
 def test_mid_decode_ttl_expiry_of_a_sharer():
     """A sharer evicted mid-decode (TTL expiry) releases its mappings while
     the survivor keeps decoding on the same shared blocks, byte-identically
@@ -388,6 +390,7 @@ def test_mid_decode_ttl_expiry_of_a_sharer():
     assert eng2.health()["prefix_cache"]["hits_total"] > 0
 
 
+@pytest.mark.slow
 def test_journal_recovery_lands_on_shared_blocks():
     """``serve_recovered``'s prompt+prefix one-pass prefill re-maps the
     shared prompt blocks of a surviving sequence instead of re-prefilling

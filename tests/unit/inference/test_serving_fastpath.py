@@ -84,6 +84,7 @@ def test_pipelined_stepwise_matches_reference_incl_eos():
     assert a.manager.allocator.free_blocks == b.manager.allocator.free_blocks
 
 
+@pytest.mark.slow
 def test_fastpath_matches_reference_under_allocator_faults():
     """Injected allocator faults only delay scheduling; the fast path must
     produce the same tokens as the faulted reference AND the healthy run,

@@ -54,6 +54,7 @@ TP2_DP4 = {"tensor": 2, "data": 4}  # the explicit TP×DP mesh
 
 
 # ----------------------------------------------------- reference equivalence
+@pytest.mark.slow
 def test_tp2_fastpath_matches_reference_and_single_chip():
     fast = _engine(axes=TP2).generate(PROMPTS, max_new_tokens=9)
     ref = _engine({"dtype": "float32", "serving_fastpath": {"enabled": False}},
@@ -229,6 +230,7 @@ def test_tp2_fastpath_matches_reference_under_expiring_deadlines():
 HEADER = list(range(100, 124))  # 3 full shared blocks at block_size=8
 
 
+@pytest.mark.slow
 def test_tp2_prefix_cache_cow_matches_reference_and_keeps_kv_sharded():
     """CoW prefix sharing at tp=2: the device block copy (`_cow_copy_block`)
     must run against the HEAD-SHARDED pool without collapsing its placement,

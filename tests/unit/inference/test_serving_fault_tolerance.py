@@ -417,6 +417,7 @@ def test_recovered_request_keeps_original_ttl(tmp_path, tiny_serving):
     assert replay_journal(path).entries[0].terminal["status"] == DEADLINE_EXPIRED
 
 
+@pytest.mark.slow
 def test_heartbeat_stamps_do_not_disturb_serve_counters(tmp_path, tiny_serving,
                                                         reference_tokens):
     # satellite: fastpath ServeCounters byte-identical heartbeats on vs off

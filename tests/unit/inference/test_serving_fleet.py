@@ -1,0 +1,425 @@
+"""Serving fleet (ISSUE 17): health-gated router over N supervised replicas
+with journaled failover and zero lost requests.
+
+Layout mirrors the layer cake: pure routing policy against synthetic health
+snapshots (no jax), structured shed backpressure units (no jax), journal
+transplant mechanics (no jax), shed re-route/backoff orchestration against
+stub supervisors (no jax), then fleet integration on the tiny llama config
+(CPU, greedy — the byte-identity asserts rest on decode determinism)."""
+
+import pytest
+
+from deepspeed_tpu.inference.v2.admission import (FAILED, OK, SHED,
+                                                  AdmissionQueue,
+                                                  RequestResult)
+from deepspeed_tpu.inference.v2.journal import RequestJournal, replay_journal
+from deepspeed_tpu.inference.v2.kv_metrics import block_hashes
+from deepspeed_tpu.inference.v2.router import (EXHAUSTION_PENALTY,
+                                               UNROUTABLE_REASON, FleetRouter)
+from deepspeed_tpu.inference.v2.supervisor import ServeSpec
+from deepspeed_tpu.runtime.config import ServingResilienceConfig
+from tests.unit.fault_injection_serving import FakeClock
+
+
+def _no_engine():
+    raise AssertionError("routing-policy tests must not build an engine")
+
+
+def _router(tmp_path, clock, *, replicas=3, sleeps=None, **cfg):
+    config = {"replicas": replicas, "affinity_blocks": 0, "health_stale_s": 5.0}
+    config.update(cfg)
+    return FleetRouter(_no_engine, journal_dir=str(tmp_path), config=config,
+                       block_size=4, clock=clock, wall_clock=clock,
+                       sleep=(sleeps.append if sleeps is not None else
+                              (lambda s: None)))
+
+
+def _health(clock, *, queue_depth=0, kv_utilization=0.0, steps=None):
+    return {"generated_at": clock.t, "queue_depth": queue_depth,
+            "kv_utilization": kv_utilization,
+            "kv": {"forecast": {"steps_to_exhaustion": steps}}}
+
+
+# ============================================================ routing policy
+def test_route_least_loaded_healthy(tmp_path):
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock)
+    router.observe(0, _health(clock, queue_depth=6))
+    router.observe(1, _health(clock, queue_depth=1))
+    router.observe(2, _health(clock, queue_depth=3, kv_utilization=0.9))
+    assert router.route([1, 2, 3]) == 1
+    # kv_weight dominates queue depth at the default 8x weighting
+    assert router._load_score(2) > router._load_score(0)
+
+
+def test_stale_health_is_unhealthy(tmp_path):
+    # satellite: a snapshot past health_stale_s (by its generated_at stamp
+    # from the injectable clock) must not attract traffic — but a fresh
+    # re-observation rehabilitates the replica
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock, replicas=2)
+    router.observe(0, _health(clock))                      # stamped at 100
+    clock.t = 110.0                                        # > 5s horizon
+    router.observe(1, _health(clock))                      # fresh at 110
+    assert router.route([1, 2, 3]) == 1
+    assert router.healthy_indices() == [1]
+    states = {r["index"]: r for r in router.health()["replicas"]}
+    assert not states[0]["healthy"] and states[1]["healthy"]
+    router.observe(0, _health(clock))
+    assert sorted(router.healthy_indices()) == [0, 1]
+
+
+def test_never_observed_replica_is_routable(tmp_path):
+    # a fresh fleet has no snapshots yet: unknown must mean healthy or the
+    # first request could never be admitted anywhere
+    router = _router(tmp_path, FakeClock(0.0), replicas=2)
+    assert router.route([1]) in (0, 1)
+    assert sorted(router.healthy_indices()) == [0, 1]
+
+
+def test_exhaustion_forecast_steers_away(tmp_path):
+    # the capacity forecaster predicting exhaustion within the steering
+    # horizon repels traffic BEFORE the replica sheds — even when its base
+    # load is lower; None (no prediction) is the healthy state
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock, replicas=2)
+    router.observe(0, _health(clock, queue_depth=0, steps=4.0))
+    router.observe(1, _health(clock, queue_depth=5, steps=None))
+    assert router._load_score(0) >= EXHAUSTION_PENALTY
+    assert router.route([1, 2, 3]) == 1
+
+
+def test_all_stale_falls_back_to_any_undrained(tmp_path):
+    # staleness may be a probe gap; drain is definitive.  With every
+    # snapshot stale the router still routes (best-effort beats refusal);
+    # with every replica drained it returns None
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock, replicas=2)
+    router.observe(0, _health(clock, queue_depth=2))
+    router.observe(1, _health(clock, queue_depth=7))
+    clock.t = 200.0
+    assert router.healthy_indices() == []
+    assert router.route([1]) == 0  # least-loaded among the undrained
+    for replica in router.replicas:
+        replica.drained = True
+    assert router.route([1]) is None
+
+
+def test_affinity_homes_shared_prefix(tmp_path):
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock, replicas=3, affinity_blocks=1)
+    shared = [7, 8, 9, 10]  # one full block at block_size=4
+    home = int.from_bytes(block_hashes(shared, 4)[-1][:8], "big") % 3
+    assert router.route(shared + [1]) == home
+    assert router.route(shared + [2, 3]) == home, \
+        "prompts sharing a header block must share a home replica"
+    assert router.affinity_routed_total == 2
+    # sub-block prompts have no hashable header: least-loaded path
+    router.observe(0, _health(clock, queue_depth=5))
+    router.observe(1, _health(clock))
+    router.observe(2, _health(clock, queue_depth=5))
+    assert router.route([1, 2]) == 1
+    assert router.affinity_routed_total == 2
+
+
+def test_affinity_overridden_when_home_unhealthy(tmp_path):
+    clock = FakeClock(100.0)
+    router = _router(tmp_path, clock, replicas=3, affinity_blocks=1)
+    shared = [7, 8, 9, 10]
+    home = int.from_bytes(block_hashes(shared, 4)[-1][:8], "big") % 3
+    others = [i for i in range(3) if i != home]
+    for i in others:
+        router.observe(i, _health(clock))
+    stale = dict(_health(clock), generated_at=clock.t - 100.0)
+    router.observe(home, stale)
+    assert router.route(shared) in others
+    assert router.affinity_overridden_total == 1
+    # a home under exhaustion pressure is also overridden (healthy != home)
+    router.observe(home, _health(clock, steps=1.0))
+    assert router.route(shared) in others
+    assert router.affinity_overridden_total == 2
+
+
+def test_serve_rejects_uid_reuse(tmp_path):
+    router = _router(tmp_path, FakeClock(0.0), replicas=1)
+    with pytest.raises(ValueError, match="unique"):
+        router.serve([[1], [2]], uids=[5, 5])
+    router._served_uids.add(9)
+    with pytest.raises(ValueError, match="unique"):
+        router.serve([[1]], uids=[9])
+
+
+# ================================================== structured backpressure
+def test_shed_reasons_carry_retry_after_hint():
+    # satellite: queue_full scales with the depth cap; kv_pressure grows
+    # with the overshoot past the shed threshold; both clamp to a sane band
+    q = AdmissionQueue(ServingResilienceConfig(max_queue_depth=2))
+    assert q.submit(0, [1, 2]) is None
+    assert q.submit(1, [1, 2]) is None
+    reason = q.submit(2, [1, 2])
+    assert reason.code == "queue_full" and reason.retryable
+    assert reason.retry_after_s == pytest.approx(0.05)  # tiny cap -> floor
+    assert "retry in ~" in str(reason)
+    assert q.shed_by_code == {"queue_full": 1}
+    assert q.last_retry_after["queue_full"] == pytest.approx(0.05)
+
+    q2 = AdmissionQueue(ServingResilienceConfig(shed_kv_utilization=0.9))
+    mild = q2.shed_reason(4, kv_utilization=0.92)
+    saturated = q2.shed_reason(4, kv_utilization=1.0)
+    assert mild.code == "kv_pressure" and saturated.code == "kv_pressure"
+    assert 0.0 < mild.retry_after_s < saturated.retry_after_s <= 2.0
+    # non-retryable sheds carry no hint: retrying can never succeed
+    assert q2.shed_reason(0).retry_after_s is None
+
+
+def test_backoff_honors_hint_floor_and_cap(tmp_path):
+    router = _router(tmp_path, FakeClock(0.0), replicas=1,
+                     backoff_base_s=0.1, backoff_max_s=1.5)
+    assert router._backoff_delay(0, []) == pytest.approx(0.1)
+    assert router._backoff_delay(2, []) == pytest.approx(0.4)  # 0.1 * 2^2
+    assert router._backoff_delay(0, [0.7]) == pytest.approx(0.7)  # hint wins
+    assert router._backoff_delay(1, [0.05]) == pytest.approx(0.2)  # floor wins
+    assert router._backoff_delay(9, [9.9]) == pytest.approx(1.5)  # cap
+
+
+# ======================================================== journal transplant
+def test_record_admit_transplants_original_wall(tmp_path):
+    path = str(tmp_path / "j.wal")
+    j = RequestJournal(path, fsync_every=1, wall_clock=FakeClock(999.0))
+    j.record_admit(0, [1, 2], ttl_s=30.0, max_new_tokens=8, admit_wall=123.0)
+    j.record_admit(1, [3], max_new_tokens=8)
+    j.close()
+    state = replay_journal(path)
+    assert state.entries[0].admit_wall == 123.0, \
+        "admit_wall override must carry the ORIGINAL clock, not the writer's"
+    assert state.entries[0].ttl_s == 30.0
+    assert state.entries[1].admit_wall == 999.0
+
+
+def test_migrate_adopts_terminals_and_transplants_inflight(tmp_path):
+    clock = FakeClock(200.0)
+    router = _router(tmp_path, clock, replicas=2)
+    dead = RequestJournal(router.replicas[0].journal_path, fsync_every=1,
+                          wall_clock=FakeClock(100.0))
+    dead.open_generation(0)
+    dead.record_admit(1, [1, 2, 3], ttl_s=30.0, max_new_tokens=8)
+    dead.note_tokens(1, [5, 6])
+    dead.flush()
+    dead.record_admit(2, [4, 5], max_new_tokens=8)
+    dead.record_terminal(2, OK, finish_reason="eos", n_tokens=0)
+    dead.close()
+    specs = [ServeSpec(uid=1, prompt=[1, 2, 3]), ServeSpec(uid=2, prompt=[4, 5]),
+             ServeSpec(uid=3, prompt=[9, 9])]  # uid 3 died before its admit
+    adopted, regrouped, lost = router._migrate(0, specs)
+    assert list(adopted) == [2] and adopted[2].status == OK
+    assert lost == {} and router.lost_total == 0
+    assert sorted(s.uid for s in regrouped[1]) == [1, 3]
+    assert router.migrated_requests_total == 2
+    assert router.adopted_from_journal_total == 1
+    state = replay_journal(router.replicas[1].journal_path)
+    entry = state.entries[1]
+    assert entry.prompt == [1, 2, 3] and entry.emitted == [5, 6]
+    assert entry.admit_wall == 100.0 and entry.ttl_s == 30.0, \
+        "the transplant must keep the ORIGINAL ttl/wall pair"
+    assert entry.max_new_tokens == 8 and not entry.done
+    assert 3 not in state.entries  # nothing journaled -> target admits fresh
+    # the dead journal is untouched forensic truth
+    assert not replay_journal(router.replicas[0].journal_path).entries[1].done
+
+
+def test_migrate_with_no_target_finalizes_lost(tmp_path):
+    router = _router(tmp_path, FakeClock(0.0), replicas=2)
+    RequestJournal(router.replicas[0].journal_path, fsync_every=1).close()
+    router.replicas[1].drained = True
+    adopted, regrouped, lost = router._migrate(0, [ServeSpec(uid=7, prompt=[1])])
+    assert adopted == {} and regrouped == {}
+    assert lost[7].status == FAILED and lost[7].retryable
+    assert lost[7].reason == UNROUTABLE_REASON
+    assert router.lost_total == 1
+
+
+# ============================================== shed re-route orchestration
+class StubSupervisor:
+    """serve_specs-compatible stand-in: scripted per-call outcomes."""
+
+    def __init__(self, script):
+        self.script = list(script)  # each item: uid -> RequestResult factory
+        self.calls = []
+        self.degraded = False
+        self.restarts_total = 0
+        self.generations = 0
+        self.ops = None
+
+    def serve_specs(self, specs, *, max_new_tokens, eos_token_id=None,
+                    greedy=True, on_generation=None):
+        self.calls.append([s.uid for s in specs])
+        behave = self.script.pop(0) if self.script else None
+        results = {}
+        for spec in specs:
+            if behave and spec.uid in behave:
+                results[spec.uid] = behave[spec.uid](spec.uid)
+            else:
+                results[spec.uid] = RequestResult(uid=spec.uid, status=OK,
+                                                  tokens=list(spec.prompt))
+        return results, False
+
+    def close_ops(self):
+        pass
+
+
+def _shed(retry_after_s=None, retryable=True):
+    return lambda uid: RequestResult(uid=uid, status=SHED, retryable=retryable,
+                                     reason="stub shed",
+                                     retry_after_s=retry_after_s)
+
+
+def test_retryable_shed_reroutes_with_hinted_backoff(tmp_path):
+    sleeps = []
+    router = _router(tmp_path, FakeClock(0.0), replicas=2, sleeps=sleeps,
+                     backoff_base_s=0.05, backoff_max_s=2.0)
+    router.replicas[0].supervisor = StubSupervisor([{0: _shed(0.7), 1: _shed(0.7)}])
+    router.replicas[1].supervisor = StubSupervisor([])
+    # both requests land on replica 0 (least index at equal load), get shed
+    # with a 0.7s hint, and must complete on replica 1 after ONE backoff
+    results = router.serve([[1, 2], [3, 4]], uids=[0, 1])
+    assert all(r.status == OK for r in results)
+    assert router.reroutes_total == 2
+    assert sleeps == [pytest.approx(0.7)], \
+        "backoff must honor the shed's own retry_after_s hint"
+    assert router.backoff_seconds_total == pytest.approx(0.7)
+    assert router.replicas[1].supervisor.calls == [[0, 1]]
+    events = [e["event"] for e in router.recorder.tail()]
+    assert "reroute" in events and "backoff" in events
+
+
+def test_non_retryable_shed_surfaces_immediately(tmp_path):
+    sleeps = []
+    router = _router(tmp_path, FakeClock(0.0), replicas=2, sleeps=sleeps)
+    router.replicas[0].supervisor = StubSupervisor(
+        [{5: _shed(retryable=False)}])
+    router.replicas[1].supervisor = StubSupervisor([])
+    results = router.serve([[1, 2]], uids=[5])
+    assert results[0].status == SHED and not results[0].retryable
+    assert router.reroutes_total == 0 and sleeps == []
+
+
+def test_reroute_budget_exhausted_surfaces_shed(tmp_path):
+    sleeps = []
+    router = _router(tmp_path, FakeClock(0.0), replicas=3, sleeps=sleeps,
+                     max_reroutes=2)
+    # every replica sheds uid 0 forever: after max_reroutes rounds the shed
+    # reaches the caller instead of looping (shed_at also forbids returning
+    # to a replica whose journal already holds the shed terminal)
+    for replica in router.replicas:
+        replica.supervisor = StubSupervisor([{0: _shed(0.1)}] * 5)
+    results = router.serve([[1, 2]], uids=[0])
+    assert results[0].status == SHED and results[0].retryable
+    visited = [r.supervisor.calls for r in router.replicas]
+    assert sum(len(c) for c in visited) == 3, \
+        f"one attempt per replica, never revisiting a shedder: {visited}"
+
+
+# ========================================================= fleet integration
+@pytest.fixture(scope="module")
+def tiny_fleet():
+    import jax
+
+    from deepspeed_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    import numpy as np
+    rng = np.random.default_rng(1)
+    shared = rng.integers(1, 128, 8).tolist()  # one full affinity block
+    prompts = ([shared + rng.integers(1, 128, int(n)).tolist()
+                for n in rng.integers(2, 6, 2)]
+               + [rng.integers(1, 128, int(n)).tolist()
+                  for n in rng.integers(4, 12, 2)])
+    return llama, cfg, params, kw, prompts
+
+
+def _factory(tiny_fleet):
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    llama, cfg, params, kw, _ = tiny_fleet
+
+    def build():
+        return InferenceEngineV2(llama, cfg, params,
+                                 config={"dtype": "float32"}, **kw)
+    return build
+
+
+@pytest.fixture(scope="module")
+def fleet_reference(tiny_fleet):
+    return _factory(tiny_fleet)().generate(tiny_fleet[4], max_new_tokens=8)
+
+
+@pytest.mark.slow
+def test_fleet_serve_matches_single_engine(tmp_path, tiny_fleet,
+                                           fleet_reference):
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    prompts = tiny_fleet[4]
+    router = FleetRouter(_factory(tiny_fleet), journal_dir=str(tmp_path),
+                         config={"replicas": 2, "affinity_blocks": 1},
+                         ft_config={"enabled": True, "max_restarts": 2},
+                         block_size=8)
+    results = router.serve(prompts, max_new_tokens=8)
+    for result, tokens in zip(results, fleet_reference):
+        assert result.ok and result.tokens == tokens, \
+            "fleet routing changed the tokens"
+    # the two shared-header prompts hashed to ONE home replica
+    assert router.affinity_routed_total >= 2
+    assert router.lost_total == 0 and router.migrations_total == 0
+    families = parse_exposition(router.metrics_text())
+    assert "dstpu_router_routed_total" in families
+    assert "dstpu_serving_completed_total" in families
+    health = router.health()
+    assert health["healthy_replicas"] == 2
+    assert sum(health["routed_total"]) == len(prompts)
+
+
+@pytest.mark.slow
+def test_fleet_failover_migrates_journaled_work(tmp_path, tiny_fleet,
+                                                fleet_reference):
+    # replica 0's engine crashes mid-serve on every generation: the
+    # supervisor burns its budget, the router drains it and transplants the
+    # journaled in-flight work to replica 1 — byte-identical continuation,
+    # zero lost requests, monotone fleet counters across the failover
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    prompts = tiny_fleet[4]
+    healthy_factory = _factory(tiny_fleet)
+
+    def flaky_factory():
+        eng = healthy_factory()
+        real = eng.scheduler.schedule
+
+        def boom(*args, **kwargs):
+            boom.steps += 1
+            if boom.steps >= 2:  # admit + emit a little, then die
+                raise RuntimeError("injected fleet fault")
+            return real(*args, **kwargs)
+        boom.steps = 0
+        eng.scheduler.schedule = boom
+        return eng
+
+    router = FleetRouter([flaky_factory, healthy_factory],
+                         journal_dir=str(tmp_path),
+                         config={"replicas": 2, "affinity_blocks": 0},
+                         ft_config={"enabled": True, "max_restarts": 1},
+                         block_size=8)
+    results = router.serve(prompts, max_new_tokens=8)
+    for result, tokens in zip(results, fleet_reference):
+        assert result.ok and result.tokens == tokens, \
+            "migrated decode diverged from the uninterrupted run"
+    assert router.lost_total == 0
+    assert router.migrations_total == 1
+    assert router.migrated_requests_total >= 1
+    assert router.replicas[0].drained
+    assert [e for e in router.recorder.tail() if e["event"] == "migrate"]
+    families = parse_exposition(router.metrics_text())
+    assert families["dstpu_router_migrations_total"]["type"] == "counter"
+    assert "dstpu_serving_restarts_total" in families
+    # a later workload routes around the drained replica without drama
+    more = router.serve([[3, 1, 4, 1, 5]], uids=[100], max_new_tokens=4)
+    assert more[0].ok and router.lost_total == 0

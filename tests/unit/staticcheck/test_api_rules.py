@@ -328,6 +328,7 @@ class TestTestsScanRoot:
 
 
 class TestInTreeAcceptance:
+    @pytest.mark.slow
     def test_package_and_tests_lint_clean_with_both_rules(self):
         """The whole tree — package AND tests — is clean under the two new
         rules against the committed manifest and the real compat registry."""

@@ -296,6 +296,62 @@ class TestHostSyncInHotPath:
             """, self.RULE, filename="deepspeed_tpu/tools/reportgen.py")
         assert out == []
 
+    # ---- fleet router whole-file scan (ISSUE 17): routing/failover runs in
+    # the request admission path and must stay host-side — stricter than the
+    # per-function v2 scan that would otherwise apply to the module, since
+    # .item() and module-level fetches are findings here too
+    def test_fleet_router_flags_fetch_in_any_function(self):
+        out = run("""
+            import numpy as np
+
+            class FleetRouter:
+                def _load_score(self, index):
+                    return float(np.asarray(self.replicas[index].load))
+            """, self.RULE,
+            filename="deepspeed_tpu/inference/v2/router.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"]
+        assert "zero-device-sync" in out[0].message
+
+    def test_fleet_router_flags_item_and_module_level(self):
+        # .item() is a finding in the router even though the package-wide v2
+        # scan would let it pass, and module level is covered too
+        out = run("""
+            import jax
+
+            SEED = jax.device_get(0)
+
+            def route(scores):
+                return scores.argmin().item()
+            """, self.RULE,
+            filename="deepspeed_tpu/inference/v2/router.py")
+        assert rules_of(out) == ["host-sync-in-hot-path"] * 2
+
+    def test_fleet_router_allows_host_hashing_and_journal_work(self):
+        # the router's real work — affinity hashing, health dict reads,
+        # journal replay bookkeeping — is pure host code and must stay clean
+        out = run("""
+            def route(self, prompt, exclude=()):
+                hashes = block_hashes(list(prompt)[:16], self.block_size)
+                if not hashes:
+                    return None
+                home = int.from_bytes(hashes[-1][:8], "big") % len(self.replicas)
+                score = float(self.replicas[home].health.get("queue_depth", 0))
+                return home if score < 2.0 else None
+            """, self.RULE,
+            filename="deepspeed_tpu/inference/v2/router.py")
+        assert out == []
+
+    def test_v2_files_beside_router_keep_per_function_scan(self):
+        # the stricter whole-file contract covers exactly router.py — its v2
+        # siblings keep the package scan, where .item() on host scalars in
+        # non-hot functions stays legal
+        out = run("""
+            def health(self):
+                return {"depth": self._depth.item()}
+            """, self.RULE,
+            filename="deepspeed_tpu/inference/v2/scheduler.py")
+        assert out == []
+
 
 # ------------------------------------------------------ traced-control-flow
 class TestTracedControlFlow:
@@ -761,6 +817,7 @@ def test_parse_error_is_reported_not_raised():
     assert rules_of(out) == ["parse-error"]
 
 
+@pytest.mark.slow
 def test_in_tree_acceptance_every_rule_demonstrated():
     """The PR's acceptance bar: running dslint over the real package must be
     CLEAN, with every rule witnessed by at least one in-tree suppression or a

@@ -191,6 +191,7 @@ def test_random_ltd_token_counts_follow_schedule():
     assert seen == [S, K, S], seen
 
 
+@pytest.mark.slow
 def test_random_ltd_reaches_engine_from_config():
     """data_efficiency.data_routing alone engages token dropping through
     initialize() (reference convert_to_random_ltd from config,

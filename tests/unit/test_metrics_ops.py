@@ -301,6 +301,66 @@ class TestFleetAggregator:
             agg.absorb(rank, reg.snapshot())
         parse_exposition(render(agg.registry()))  # strict-parse clean
 
+    def test_simultaneous_replica_restarts_carry_independently(self):
+        """The fleet-failover window (ISSUE 17): TWO replicas bump
+        generations in the same merge window.  Each rank's counter carry is
+        independent — rank 0's restart must not disturb rank 1's total, the
+        merged counters stay monotone through the simultaneous bumps, and
+        histogram merges stay rank-blind-exact (quantiles of the merged
+        histogram equal quantiles over the union of every generation's
+        samples on both ranks)."""
+        agg = FleetAggregator()
+
+        def absorb(rank, generation, count, samples):
+            reg = MetricsRegistry(generation=generation)
+            reg.set_counter("dstpu_req_total", count)
+            reg.set_histogram("dstpu_lat_seconds", _hist(samples))
+            agg.absorb(rank, reg.snapshot())
+
+        def totals():
+            fam = agg.registry().families["dstpu_req_total"]
+            return (fam.samples[label_key({"rank": "0"})],
+                    fam.samples[label_key({"rank": "1"})])
+
+        absorb(0, 0, 7, [0.001, 0.01])
+        absorb(1, 0, 3, [0.1])
+        assert totals() == (7, 3)
+        # both replicas restart in the SAME window; fresh counters from 0
+        absorb(0, 1, 0, [])
+        absorb(1, 1, 0, [])
+        assert totals() == (7, 3), "a double restart must not drop either carry"
+        absorb(0, 1, 2, [1.0])
+        absorb(1, 1, 5, [0.01])
+        assert totals() == (9, 8)
+        # rank 1 restarts AGAIN while rank 0 keeps counting in generation 1
+        # (snapshots are cumulative lifetime state within a generation, so
+        # rank 0's newer snapshot still contains its earlier sample)
+        absorb(1, 2, 4, [5.0])
+        absorb(0, 1, 6, [1.0])
+        assert totals() == (13, 12)
+        merged = agg.registry().families["dstpu_lat_seconds"].samples[()]
+        union = _hist([0.001, 0.01, 0.1, 1.0, 0.01, 5.0])
+        assert merged.counts == union.counts
+        assert merged.percentiles() == union.percentiles(), \
+            "cross-restart histogram merge must stay rank-blind-exact"
+        parse_exposition(render(agg.registry()))
+
+    def test_stale_straggler_during_double_restart_window(self):
+        # a slow rank file from the PRE-restart generation landing after the
+        # bump is the classic failover race: it must be dropped for the
+        # bumped rank without touching the other rank's fresh state
+        agg = FleetAggregator()
+        for rank in (0, 1):
+            reg = MetricsRegistry(generation=1)
+            reg.set_counter("dstpu_req_total", 10 + rank)
+            agg.absorb(rank, reg.snapshot())
+        straggler = MetricsRegistry(generation=0)
+        straggler.set_counter("dstpu_req_total", 999)
+        agg.absorb(0, straggler.snapshot())
+        fam = agg.registry().families["dstpu_req_total"]
+        assert fam.samples[label_key({"rank": "0"})] == 10
+        assert fam.samples[label_key({"rank": "1"})] == 11
+
 
 # ----------------------------------------------------------- HTTP endpoints
 class TestOpsServer:

@@ -138,6 +138,7 @@ def _hf_parity(mod, make_hf, atol=2e-3):
     np.testing.assert_allclose(got, ref, atol=atol, rtol=atol)
 
 
+@pytest.mark.slow
 def test_hf_opt_parity():
     _hf_parity(opt, lambda tr: tr.OPTForCausalLM(tr.OPTConfig(
         vocab_size=99, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
@@ -260,6 +261,7 @@ def test_gptj_paged_prefill_matches_forward():
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_bloom_incremental_decode_matches_forward():
     """BLOOM v1 serving: prefill + 3 decode steps through forward_with_cache
     equal the full forward's next-token logits at each position."""

@@ -143,6 +143,7 @@ def test_bert_trains_zero1():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_llama_trains_zero3_bf16():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -42,6 +42,7 @@ def _spawn(rank: int, port: int, tmp: str) -> subprocess.Popen:
                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
 
+@pytest.mark.slow
 def test_two_process_zero3_collectives_and_checkpoint(tmp_path):
     port = _free_port()
     procs = [_spawn(r, port, str(tmp_path)) for r in range(2)]
