@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke ops-stress-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed lint-suppressions
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -33,6 +33,11 @@ lint-api-surface:
 # unknown-mesh-axis rule fails CI on any unpinned/stale axis)
 lint-mesh-manifest:
 	$(PY) bin/dstpu-lint --update-mesh-manifest
+
+# audit every inline suppression: per-rule counts with file:line + reasons,
+# stale/reasonless entries highlighted; exits 1 if any need attention
+lint-suppressions:
+	$(PY) bin/dstpu-lint --list-suppressions
 
 # fast pre-push lane: lint only .py files changed vs BASE (default HEAD =
 # uncommitted work; use BASE=origin/main before pushing a branch).  Subset
@@ -93,6 +98,12 @@ tracing-smoke:
 # off (scrapes read host-side cached snapshots; zero added device syncs)
 ops-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --ops-smoke
+
+# concurrency stress (ISSUE 18): N threads hammering /metrics + /healthz +
+# health() through a mixed serve; strict-parsed responses, zero hammer-thread
+# exceptions, ServeCounters byte-identical to an unscraped run
+ops-stress-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --ops-stress-smoke
 
 # KV-pool observability (ISSUE 12): a shared-prefix serve must report a
 # non-zero counterfactual prefix-cache win (duplicate blocks + hit-rate +

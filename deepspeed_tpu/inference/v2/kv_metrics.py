@@ -250,8 +250,10 @@ class BlockCensus:
     def shared_blocks(self) -> int:
         """Blocks currently mapped by more than one sequence (copy-on-write
         prefix sharing) — the one home for this definition; the rollup and
-        the Prometheus gauge both read it."""
-        return sum(1 for rec in self.blocks.values() if len(rec.owners) > 1)
+        the Prometheus gauge both read it.  Iterates a GIL-atomic list copy:
+        health() threads call this while the serve thread allocates/frees."""
+        return sum(1 for rec in list(self.blocks.values())
+                   if len(rec.owners) > 1)
 
     def tokens_resident(self) -> int:
         return self._resident_total
@@ -269,7 +271,9 @@ class BlockCensus:
         accumulation over dead blocks.  Age 0 lands in the underflow bucket
         (representative 0.0); quantiles are deterministic."""
         hist = StreamingHistogram(self._age_bpd, 1.0)
-        for rec in self.blocks.values():
+        # list copy: built on demand from health()/scrape threads while the
+        # serve thread mutates the census — iterating the live dict crashes
+        for rec in list(self.blocks.values()):
             hist.add(float(self.step - rec.allocated_step))
         return hist
 
@@ -277,7 +281,7 @@ class BlockCensus:
         """Steps since each block was last touched — the cold-block signal an
         age-aware quantization policy would key on."""
         hist = StreamingHistogram(self._age_bpd, 1.0)
-        for rec in self.blocks.values():
+        for rec in list(self.blocks.values()):  # list copy: see age_histogram
             hist.add(float(self.step - rec.last_touched_step))
         return hist
 
@@ -303,8 +307,10 @@ class BlockCensus:
 
     def table(self) -> Dict[int, Dict[str, int]]:
         """The full per-block census (state_snapshot diagnostics; bounded by
-        the pool size)."""
-        return {b: rec.as_dict() for b, rec in sorted(self.blocks.items())}
+        the pool size).  Sorts a GIL-atomic list copy — diagnostics threads
+        read this while the serve thread allocates/frees."""
+        return {b: rec.as_dict()
+                for b, rec in sorted(list(self.blocks.items()))}
 
     # ---------------------------------------------------------- invariant
     def check_against(self, allocator, seqs: Optional[Dict[int, Any]] = None) -> None:

@@ -547,7 +547,10 @@ class RaggedStateManager:
             self.completed_requests += 1
 
     def live_uids(self) -> List[int]:
-        return [uid for uid, s in self.seqs.items() if not s.done]
+        # list copy first (GIL-atomic): health() threads call this while the
+        # serve thread admits/retires sequences; the comprehension's per-item
+        # bytecode would otherwise crash on a concurrent insert
+        return [uid for uid, s in list(self.seqs.items()) if not s.done]
 
     def kv_utilization(self) -> float:
         """Fraction of the usable KV pool currently allocated (trash block

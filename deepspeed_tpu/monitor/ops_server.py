@@ -72,6 +72,7 @@ class OpsCache:
             self.healthz = healthz
         if statez is not None:
             self.statez = statez
+        # dslint: disable-next-line=atomic-publish  # update() is only ever called from the publisher's owning thread (single writer); handler threads read the three text attrs but never touch refreshes, so the += cannot interleave with anything
         self.refreshes += 1
 
 

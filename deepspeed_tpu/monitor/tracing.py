@@ -126,15 +126,21 @@ class StreamingHistogram:
 
     def quantile(self, q: float) -> Optional[float]:
         """Value at quantile ``q`` in [0, 1]; None while empty."""
-        if not self.count:
+        # one GIL-atomic copy: health()/scrape threads read these histograms
+        # while the serve thread add()s, and iterating the live bucket dict
+        # with interleaved bytecode would crash on a concurrent insert (the
+        # copied view may lag by an in-flight add; quantiles tolerate that)
+        counts = dict(self.counts)
+        count = sum(counts.values())
+        if not count:
             return None
-        rank = max(1, math.ceil(q * self.count))
+        rank = max(1, math.ceil(q * count))
         cum = 0
-        for idx in sorted(self.counts):
-            cum += self.counts[idx]
+        for idx in sorted(counts):
+            cum += counts[idx]
             if cum >= rank:
                 return self.representative(idx)
-        return self.representative(max(self.counts))  # q > 1 degrades to max bucket
+        return self.representative(max(counts))  # q > 1 degrades to max bucket
 
     def percentiles(self) -> Optional[Dict[str, float]]:
         """{p50, p95, p99} or None while empty."""

@@ -76,6 +76,11 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
     def __init__(self, max_queue: int = 64):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        # _error crosses the worker/caller boundary: written by the worker on
+        # a failed write, swapped out by _raise_pending() on the caller side.
+        # Both sides hold _error_lock — an unlocked version loses the error
+        # when the swap interleaves with a concurrent worker store.
+        self._error_lock = threading.Lock()
         self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
@@ -89,7 +94,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
             try:
                 np.save(path, arr)
             except BaseException as exc:  # surfaced at flush()/commit()
-                self._error = exc
+                with self._error_lock:
+                    self._error = exc
             finally:
                 self._queue.task_done()
 
@@ -98,13 +104,13 @@ class AsyncCheckpointEngine(CheckpointEngine):
         OSError from a flaky mount stays an OSError, so the checkpoint retry
         loop can recognize it as transient) and clear it so a retried save
         starts clean."""
-        exc, self._error = self._error, None
+        with self._error_lock:
+            exc, self._error = self._error, None
         if exc is not None:
             raise exc
 
     def save(self, arr: np.ndarray, path: str) -> None:
-        if self._error is not None:
-            self._raise_pending()
+        self._raise_pending()
         self._queue.put((np.asarray(arr), path))
 
     def load(self, path: str) -> np.ndarray:

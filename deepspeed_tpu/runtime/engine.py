@@ -1191,6 +1191,7 @@ class Engine:
 
     def _on_preemption(self, signum=None, frame=None):
         import signal
+        # dslint: disable-next-line=handler-holds-engine  # the PR-2 save_on_preemption contract IS "the handler drives the engine": CPython runs signal handlers on the main thread between bytecodes, so this never executes concurrently with a step, and a best-effort final save_checkpoint is the whole point
         if not self._in_preempt_save and self._preempt_save_dir is not None:
             self._in_preempt_save = True
             try:

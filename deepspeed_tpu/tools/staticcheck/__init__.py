@@ -19,9 +19,10 @@ from .mesh_model import (DEFAULT_MESH_MANIFEST_NAME, MeshModel,
                          save_mesh_manifest)
 from .rules import META_RULES, RULES, build_rules
 from .runner import LintResult, lint_source, run_lint
+from .thread_model import ThreadModel
 
 __all__ = ["DEFAULT_BASELINE_NAME", "DEFAULT_MESH_MANIFEST_NAME", "Finding",
-           "LintResult", "META_RULES", "MeshModel", "RULES", "build_rules",
-           "collect_mesh_axes", "lint_source", "load_baseline",
+           "LintResult", "META_RULES", "MeshModel", "RULES", "ThreadModel",
+           "build_rules", "collect_mesh_axes", "lint_source", "load_baseline",
            "load_mesh_manifest", "run_lint", "save_baseline",
            "save_mesh_manifest"]

@@ -7,7 +7,9 @@
     dstpu-lint --update-baseline         # grandfather current findings
     dstpu-lint --update-api-surface      # re-pin the external jax surface
     dstpu-lint --update-mesh-manifest    # re-pin the declared mesh axes
+    dstpu-lint --jobs 4                  # fork 4 workers over the file pass
     dstpu-lint --list-rules
+    dstpu-lint --list-suppressions       # audit inline suppressions
 
 Exit codes: 0 clean, 1 non-baselined findings, 2 usage/internal error.
 """
@@ -72,8 +74,56 @@ def _parser() -> argparse.ArgumentParser:
                    help="comma-separated rule names to run exclusively")
     p.add_argument("--no-unused-suppressions", action="store_true",
                    help="don't report stale suppression comments")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="fork N parallel workers over the per-file rule pass "
+                        "(0 = cpu count); the project-context build stays "
+                        "single-pass, and results are identical to -j1")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--list-suppressions", action="store_true",
+                   help="audit every inline suppression: per-rule counts with "
+                        "file:line and reasons, stale/reasonless entries "
+                        "highlighted; exits 1 if any need attention")
     return p
+
+
+def _list_suppressions(paths, root, rules, api_surface, mesh_manifest,
+                       jobs: int) -> int:
+    """The ``--list-suppressions`` audit.  Static inventory first (every
+    suppression comment, including inert reasonless ones), then a full lint
+    to mark entries whose finding no longer exists as stale."""
+    from .suppressions import parse_suppressions
+    modules, _ = load_modules(iter_python_files(paths), root)
+    entries = []            # (relpath, Suppression)
+    reasonless = []         # bad-suppression Findings
+    for mod in modules:
+        sups, problems = parse_suppressions(mod.source, mod.relpath)
+        entries.extend((mod.relpath, s) for s in sups)
+        reasonless.extend(p for p in problems if p.rule == "bad-suppression")
+    result = run_lint(paths, root=root, rules=rules, baseline={},
+                      report_unused_suppressions=True,
+                      api_surface=api_surface, mesh_manifest=mesh_manifest,
+                      jobs=jobs)
+    stale = {(f.path, f.line) for f in result.findings
+             if f.rule == "unused-suppression"}
+    n_stale = sum(1 for rp, s in entries if (rp, s.line) in stale)
+    print(f"dstpu-lint: {len(entries)} suppression(s) across "
+          f"{len({rp for rp, _ in entries})} file(s); {n_stale} stale, "
+          f"{len(reasonless)} without a reason")
+    by_rule: dict = {}
+    for rp, s in entries:
+        for r in s.rules:
+            by_rule.setdefault(r, []).append((rp, s))
+    for rule in sorted(by_rule):
+        rows = sorted(by_rule[rule], key=lambda t: (t[0], t[1].line))
+        print(f"\n{rule} ({len(rows)})")
+        for rp, s in rows:
+            mark = " [STALE]" if (rp, s.line) in stale else ""
+            print(f"  {rp}:{s.line}{mark}  {s.reason}")
+    if reasonless:
+        print(f"\nwithout a reason ({len(reasonless)}) — inert; fix or remove")
+        for p in sorted(reasonless, key=lambda f: (f.path, f.line)):
+            print(f"  {p.path}:{p.line} [NO REASON]  {p.snippet}")
+    return 1 if (n_stale or reasonless) else 0
 
 
 def main(argv=None) -> int:
@@ -86,6 +136,16 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root or os.getcwd())
+    if args.jobs < 0:
+        print("dstpu-lint: --jobs must be >= 0", file=sys.stderr)
+        return 2
+    jobs = args.jobs or (os.cpu_count() or 1)
+    if args.list_suppressions and (args.update_baseline or
+                                   args.update_api_surface or
+                                   args.update_mesh_manifest):
+        print("dstpu-lint: --list-suppressions is a read-only audit; it "
+              "cannot be combined with --update-*", file=sys.stderr)
+        return 2
     if args.changed is not None and args.paths:
         print("dstpu-lint: --changed computes its own file set; explicit "
               "paths cannot be combined with it", file=sys.stderr)
@@ -221,9 +281,14 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    if args.list_suppressions:
+        return _list_suppressions(paths, root, rules, api_surface,
+                                  mesh_manifest, jobs)
+
     result = run_lint(paths, root=root, rules=rules, baseline=baseline,
                       report_unused_suppressions=not args.no_unused_suppressions,
-                      api_surface=api_surface, mesh_manifest=mesh_manifest)
+                      api_surface=api_surface, mesh_manifest=mesh_manifest,
+                      jobs=jobs)
 
     if args.update_baseline:
         # meta findings (stale suppressions, bad comments, parse errors) are

@@ -440,6 +440,10 @@ class ProjectContext:
         # deferred import: mesh_model imports ModuleInfo from this module
         from .mesh_model import MeshModel
         self.mesh_model = MeshModel(modules)
+        # same pattern for the concurrency layer: the cross-module thread
+        # model (thread roots, reachability, attribute/lock facts)
+        from .thread_model import ThreadModel
+        self.thread_model = ThreadModel(modules)
 
     def jit_roots(self, module: ModuleInfo) -> Dict[int, JitRoot]:
         return self._jit_roots.get(module.relpath, {})

@@ -50,6 +50,29 @@ suppression reasons left in-tree for the survivors):
 - donation-sharding-mismatch: a donated argument rebound to a
   differently-specced placement — donation aliasing needs identical
   shardings, so the "in-place" update silently degrades to a copy.
+
+The concurrency layer (ISSUE 18) reasons over a cross-module thread model
+(``thread_model.py``): which functions run on spawned threads / HTTP handler
+threads / collector callbacks / signal handlers, which attributes each plane
+touches, and which locks are held at each touch:
+
+- cross-thread-mutation: the same attribute written from two planes with no
+  common lock — the bug class behind AsyncCheckpointEngine._error, where a
+  worker-thread store raced the caller's read-and-clear swap and lost the
+  error.
+- atomic-publish: a shared attribute updated by augmented assignment,
+  in-place container mutation, or a rebind to a freshly-built mutable
+  container — readers on the other plane can observe half-applied state;
+  the convention is one GIL-atomic pointer store of a complete immutable
+  value (the OpsCache pattern).
+- handler-holds-engine: an HTTP handler / collector / signal root that
+  reaches an engine or manager object — handlers must read pre-rendered
+  snapshots, never drive serving machinery from a foreign thread.
+- blocking-under-lock: ``sleep``/``join``/``subprocess``/collective calls
+  while holding a lock — stalls every thread contending on it (scrapes,
+  health probes) for the full blocking duration.
+- lock-order: two locks acquired in both A→B and B→A orders across the
+  tree — the classic ABBA deadlock, invisible until two threads interleave.
 """
 
 import ast
@@ -1465,6 +1488,281 @@ class DonationShardingMismatch(Rule):
                     f"donated value's lifetime or drop the donation")
             else:
                 placements[expr] = (key, node.lineno)
+
+
+# --------------------------------------------------------------------------
+# Concurrency rules (threadcheck).  All five consume ctx.thread_model — the
+# cross-module thread plane built by thread_model.py (thread roots,
+# reachability, attribute events with held-lock sets, lock-order edges).
+# The model is global but rules report per-module: each rule runs the
+# project-wide analysis once per context and replays the findings that land
+# in the module being linted.
+
+
+class _ThreadRule(Rule):
+    """Base: one project-wide analysis per ProjectContext, findings replayed
+    per module (the runner lints module-by-module; a cross-module race must
+    surface in whichever file is being checked)."""
+
+    def check(self, module, ctx):
+        if getattr(self, "_ctx_id", None) != id(ctx):
+            self._ctx_id = id(ctx)
+            self._by_module: Dict[str, List] = {}
+            for relpath, node, message in self._analyze(ctx.thread_model):
+                self._by_module.setdefault(relpath, []).append((node, message))
+            for findings in self._by_module.values():
+                findings.sort(key=lambda t: (t[0].lineno, t[0].col_offset))
+        for node, message in self._by_module.get(module.relpath, []):
+            yield self.finding(module, node, message)
+
+    def _analyze(self, tm):
+        raise NotImplementedError
+
+
+def _root_phrase(tm, key) -> str:
+    root = tm.root_for(key, ("thread", "handler", "collector"))
+    return f" (thread-entered via {root.label})" if root is not None else ""
+
+
+@register
+class CrossThreadMutation(_ThreadRule):
+    name = "cross-thread-mutation"
+    description = ("shared attribute written from a thread-reachable function "
+                   "AND written (or read-modify-written) from the main "
+                   "serve/train path with no common lock — a lost-update race "
+                   "outside the sanctioned single-writer atomic-publish "
+                   "pattern (the AsyncCheckpointEngine._error class of bug)")
+
+    def _analyze(self, tm):
+        from .thread_model import AttrEvent  # noqa: F401 (documentation)
+        for (owner, attr), events in sorted(tm.attr_events.items()):
+            if tm.is_threadsafe_attr(owner, attr):
+                continue
+            evs = [e for e in events
+                   if not e.in_init and tm.plane_of(e.func) != "signal"]
+            thread = [e for e in evs if tm.plane_of(e.func) == "thread"]
+            main = [e for e in evs if tm.plane_of(e.func) == "main"]
+            if not thread or not main:
+                continue
+            reported: Set[int] = set()
+
+            def report(e, other, why):
+                if id(e.node) in reported:
+                    return ()
+                reported.add(id(e.node))
+                return ((e.relpath, e.node,
+                         f"'{owner}.{attr}' {why} — the other side is at "
+                         f"{other.relpath}:{other.node.lineno}"
+                         f"{_root_phrase(tm, e.func if tm.plane_of(e.func) == 'thread' else other.func)}; "
+                         f"hold one common lock on both sides, or restructure "
+                         f"so a single thread owns every write and publishes "
+                         f"whole immutable values (the OpsCache pattern)"), )
+
+            t_writes = [e for e in thread if e.kind in ("rebind", "augassign")]
+            m_writes = [e for e in main if e.kind in ("rebind", "augassign")]
+            for tw in t_writes:
+                for mw in m_writes:
+                    if tw.locks & mw.locks:
+                        continue
+                    yield from report(
+                        tw, mw, "is written from a thread entrypoint here and "
+                        "also written on the main plane with no common lock "
+                        "(concurrent writes lose updates)")
+                    yield from report(
+                        mw, tw, "is written on the main plane here and also "
+                        "written from a thread entrypoint with no common lock "
+                        "(concurrent writes lose updates)")
+            for aug, others in ((e, main) for e in thread
+                                if e.kind == "augassign"):
+                for o in others:
+                    if aug.locks & o.locks:
+                        continue
+                    yield from report(
+                        aug, o, "is read-modify-written (+=/-=) from a thread "
+                        "entrypoint here while the main plane touches it — "
+                        "augmented assignment is not atomic even under the GIL")
+            for aug, others in ((e, thread) for e in main
+                                if e.kind == "augassign"):
+                for o in others:
+                    if aug.locks & o.locks:
+                        continue
+                    yield from report(
+                        aug, o, "is read-modify-written (+=/-=) on the main "
+                        "plane here while a thread entrypoint touches it — "
+                        "augmented assignment is not atomic even under the GIL")
+
+
+@register
+class AtomicPublish(_ThreadRule):
+    name = "atomic-publish"
+    description = ("cross-thread published state must be a whole-attribute "
+                   "rebind of an immutable value: on a class instances of "
+                   "which are touched from BOTH the thread plane and the main "
+                   "plane, in-place container mutation / subscript stores / "
+                   "augmented assignment on an unlocked attribute is a "
+                   "finding — this makes the OpsCache \"GIL-atomic whole-"
+                   "string assignment\" convention a checked contract")
+
+    def _analyze(self, tm):
+        from .thread_model import INPLACE_KINDS, is_mutable_value
+        planes_by_class: Dict[str, Set[str]] = {}
+        for (owner, _attr), events in tm.attr_events.items():
+            for e in events:
+                if not e.in_init:
+                    planes_by_class.setdefault(owner, set()).add(
+                        tm.plane_of(e.func))
+        shared = {c for c, planes in planes_by_class.items()
+                  if "thread" in planes and "main" in planes}
+        for (owner, attr), events in sorted(tm.attr_events.items()):
+            if owner not in shared or tm.is_threadsafe_attr(owner, attr):
+                continue
+            evs = [e for e in events
+                   if not e.in_init and tm.plane_of(e.func) != "signal"]
+            for e in evs:
+                other = [o for o in evs
+                         if tm.plane_of(o.func) != tm.plane_of(e.func)]
+                # lock-disciplined attrs are exempt: the event holds a lock
+                # every other-plane access of this attr also holds
+                if e.locks and all(e.locks & o.locks for o in other):
+                    continue
+                if e.kind == "augassign" and not other:
+                    # (with other-plane access this is cross-thread-mutation's
+                    # finding; here the attr itself never crosses, but it
+                    # rides on an object that DOES — same publish contract)
+                    yield (e.relpath, e.node,
+                           f"'{owner}.{attr}' is read-modify-written (+=) on "
+                           f"an instance shared across threads — not an "
+                           f"atomic publish; rebind a complete immutable "
+                           f"value instead, or move the counter off the "
+                           f"shared object")
+                elif e.kind in INPLACE_KINDS:
+                    yield (e.relpath, e.node,
+                           f"in-place mutation of '{owner}.{attr}' on an "
+                           f"instance shared across threads — a concurrent "
+                           f"reader can observe the half-applied mutation; "
+                           f"the atomic-publish contract requires building "
+                           f"the new value privately and rebinding the whole "
+                           f"attribute (one GIL-atomic pointer store)")
+                elif e.kind == "rebind" and is_mutable_value(e.value) and \
+                        any(o.kind == "read" for o in other):
+                    yield (e.relpath, e.node,
+                           f"'{owner}.{attr}' publishes a freshly-built "
+                           f"MUTABLE container to a cross-thread reader — "
+                           f"later in-place edits through this attribute "
+                           f"race those readers; publish an immutable "
+                           f"rendering (str/bytes/tuple) instead")
+
+
+@register
+class HandlerHoldsEngine(_ThreadRule):
+    name = "handler-holds-engine"
+    description = ("ops handlers, thread targets, collector callbacks and "
+                   "signal handlers may not capture or reach an engine/"
+                   "manager reference — the scrape-safety contract: a "
+                   "thread-entered function touching the engine can sync a "
+                   "device or race a step; hand it pre-rendered host state "
+                   "(the OpsCache pattern) instead")
+
+    KIND_LABEL = {"thread": "thread target", "handler": "HTTP handler",
+                  "collector": "collector callback", "signal": "signal handler"}
+
+    def _analyze(self, tm):
+        done: Set[Tuple] = set()
+        for root in tm.roots:
+            key = root.key
+            if key is None or key not in tm.functions or \
+                    (key, root.kind) in done:
+                continue
+            done.add((key, root.kind))
+            fn = tm.functions[key]
+            label = self.KIND_LABEL.get(root.kind, root.kind)
+            refs = tm.engine_refs.get(key)
+            if refs:
+                node, cls = refs[0]
+                yield (fn.relpath, node,
+                       f"{label} '{fn.key[1]}' holds a reference to "
+                       f"engine/manager class '{cls}' — thread-entered code "
+                       f"must not capture or reach the engine (it could sync "
+                       f"a device or race a step); pass pre-rendered host "
+                       f"state instead")
+                continue
+            hit = self._reachable_engine_ref(tm, key)
+            if hit is not None:
+                hk, cls = hit
+                yield (fn.relpath, fn.node,
+                       f"{label} '{fn.key[1]}' reaches engine/manager class "
+                       f"'{cls}' through '{hk[1]}' ({hk[0]}) — thread-entered "
+                       f"code must not reach the engine; pass pre-rendered "
+                       f"host state instead")
+
+    def _reachable_engine_ref(self, tm, key):
+        seen, todo = set(), sorted(tm.functions[key].resolved_callees)
+        while todo:
+            k = todo.pop(0)
+            if k in seen or k not in tm.functions:
+                continue
+            seen.add(k)
+            refs = tm.engine_refs.get(k)
+            if refs:
+                return k, refs[0][1]
+            todo.extend(sorted(tm.functions[k].resolved_callees))
+        return None
+
+
+@register
+class BlockingUnderLock(_ThreadRule):
+    name = "blocking-under-lock"
+    description = ("sleep / thread-or-queue join / fsync / subprocess / "
+                   "collective entry / device sync while holding a lock — "
+                   "every other thread contending for that lock stalls for "
+                   "the full blocking duration (and a collective under a "
+                   "lock deadlocks the fleet if any peer needs the lock to "
+                   "reach its own collective)")
+
+    def _analyze(self, tm):
+        for bc in tm.blocking_calls:
+            locks = ", ".join(sorted(bc.locks))
+            yield (bc.relpath, bc.node,
+                   f"blocking call ({bc.what}) while holding lock(s) "
+                   f"[{locks}] — move the blocking work outside the critical "
+                   f"section (compute under the lock, block outside it)")
+
+
+@register
+class LockOrder(_ThreadRule):
+    name = "lock-order"
+    description = ("inconsistent lock-acquisition order across the project — "
+                   "somewhere lock A is taken under lock B while elsewhere B "
+                   "is taken under A: the classic ABBA deadlock; pick one "
+                   "global order (document it where the locks are defined)")
+
+    def _analyze(self, tm):
+        edges: Dict[Tuple[str, str], List] = {}
+        for e in tm.lock_edges:
+            edges.setdefault((e.outer, e.inner), []).append(e)
+        seen_pairs: Set[frozenset] = set()
+        for (a, b), sites in sorted(edges.items()):
+            if a == b or (b, a) not in edges:
+                continue
+            pair = frozenset((a, b))
+            if pair in seen_pairs:
+                continue
+            seen_pairs.add(pair)
+            rev = edges[(b, a)]
+            for e in sites:
+                yield (e.relpath, e.node,
+                       f"lock '{b}' acquired while holding '{a}' here, but "
+                       f"{rev[0].relpath}:{rev[0].node.lineno} acquires "
+                       f"'{a}' while holding '{b}' — inconsistent ordering "
+                       f"is an ABBA deadlock waiting for contention; pick "
+                       f"one project-wide order")
+            for e in rev:
+                yield (e.relpath, e.node,
+                       f"lock '{a}' acquired while holding '{b}' here, but "
+                       f"{sites[0].relpath}:{sites[0].node.lineno} acquires "
+                       f"'{b}' while holding '{a}' — inconsistent ordering "
+                       f"is an ABBA deadlock waiting for contention; pick "
+                       f"one project-wide order")
 
 
 def build_rules(enabled: Optional[Iterable[str]] = None,

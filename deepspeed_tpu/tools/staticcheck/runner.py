@@ -158,40 +158,103 @@ def load_modules(files: Sequence[str], root: str):
     return modules, errors
 
 
+def _lint_one_module(mod: ModuleInfo, rules: List[Rule], ctx: ProjectContext,
+                     report_unused_suppressions: bool):
+    """Per-module pass: run rules, apply suppressions.  Returns
+    (kept findings, suppressed count) — the unit of work ``--jobs`` fans out."""
+    mod_rules = rules if not mod.relpath.startswith(TEST_PATH_PREFIX) \
+        else [r for r in rules if r.scan_tests]
+    raw: List[Finding] = []
+    for rule in mod_rules:
+        raw.extend(rule.check(mod, ctx))
+    suppressions, problems = parse_suppressions(mod.source, mod.relpath)
+    index = SuppressionIndex(suppressions)
+    kept = [f for f in raw if not index.suppresses(f)]
+    suppressed = len(raw) - len(kept)
+    kept.extend(problems)
+    if report_unused_suppressions:
+        for s in index.unused({r.name for r in mod_rules}):
+            kept.append(Finding(
+                rule="unused-suppression", path=mod.relpath, line=s.line, col=s.col,
+                message=f"suppression of {', '.join(s.rules)} matched no finding — "
+                        f"stale; remove it (reason was: {s.reason})",
+                snippet=mod.snippet(s.line), severity="warning"))
+    return kept, suppressed
+
+
+# parent-side state inherited by forked --jobs workers (copy-on-write): the
+# context is built ONCE in the parent; children only receive module indices
+_FORK_STATE = None
+
+
+def _fork_worker(indices: List[int]):
+    modules, rules, ctx, report_unused = _FORK_STATE
+    findings: List[Finding] = []
+    suppressed = 0
+    for i in indices:
+        kept, sup = _lint_one_module(modules[i], rules, ctx, report_unused)
+        findings.extend(kept)
+        suppressed += sup
+    return findings, suppressed
+
+
+def _lint_parallel(modules: List[ModuleInfo], rules: List[Rule],
+                   ctx: ProjectContext, report_unused: bool, jobs: int):
+    """Fork-based fan-out over modules.  Returns (findings, suppressed), or
+    None when fork is unavailable (caller falls back to sequential).  Fork is
+    required — spawn would re-pickle every parse tree and rebuild nothing."""
+    import multiprocessing as mp
+    global _FORK_STATE
+    try:
+        mpctx = mp.get_context("fork")
+    except ValueError:
+        return None
+    jobs = max(1, min(jobs, len(modules)))
+    if jobs == 1:
+        return None
+    # round-robin keeps big files (which cluster at similar paths) spread out
+    chunks = [list(range(i, len(modules), jobs)) for i in range(jobs)]
+    _FORK_STATE = (modules, rules, ctx, report_unused)
+    try:
+        with mpctx.Pool(jobs) as pool:
+            results = pool.map(_fork_worker, chunks)
+    finally:
+        _FORK_STATE = None
+    findings = [f for part, _ in results for f in part]
+    suppressed = sum(sup for _, sup in results)
+    return findings, suppressed
+
+
 def lint_modules(modules: List[ModuleInfo], rules: Optional[List[Rule]] = None,
                  extra_declared_keys: Iterable[str] = (),
                  report_unused_suppressions: bool = True,
                  context_modules: Optional[List[ModuleInfo]] = None,
-                 api_surface=None, mesh_manifest=None,
+                 api_surface=None, mesh_manifest=None, jobs: int = 1,
                  _stats: Optional[Dict[str, int]] = None) -> List[Finding]:
     """Findings come only from ``modules``; ``context_modules`` (a superset,
     default = modules) feeds ProjectContext so a subset lint still sees the
-    whole package's schemas/registries."""
+    whole package's schemas/registries.  ``jobs > 1`` forks that many workers
+    over the per-module pass (the context build stays single-pass in the
+    parent); results are identical to sequential by construction — each
+    module is linted exactly once against the same shared context."""
     rules = rules if rules is not None else build_rules()
     ctx = ProjectContext(context_modules or modules,
                          extra_declared_keys=extra_declared_keys,
                          api_surface=api_surface, mesh_manifest=mesh_manifest)
     findings: List[Finding] = []
     suppressed = 0
-    for mod in modules:
-        mod_rules = rules if not mod.relpath.startswith(TEST_PATH_PREFIX) \
-            else [r for r in rules if r.scan_tests]
-        raw: List[Finding] = []
-        for rule in mod_rules:
-            raw.extend(rule.check(mod, ctx))
-        suppressions, problems = parse_suppressions(mod.source, mod.relpath)
-        index = SuppressionIndex(suppressions)
-        kept = [f for f in raw if not index.suppresses(f)]
-        suppressed += len(raw) - len(kept)
-        kept.extend(problems)
-        if report_unused_suppressions:
-            for s in index.unused({r.name for r in mod_rules}):
-                kept.append(Finding(
-                    rule="unused-suppression", path=mod.relpath, line=s.line, col=s.col,
-                    message=f"suppression of {', '.join(s.rules)} matched no finding — "
-                            f"stale; remove it (reason was: {s.reason})",
-                    snippet=mod.snippet(s.line), severity="warning"))
-        findings.extend(kept)
+    parallel = None
+    if jobs != 1 and len(modules) > 1:
+        parallel = _lint_parallel(modules, rules, ctx,
+                                  report_unused_suppressions, jobs)
+    if parallel is not None:
+        findings, suppressed = parallel
+    else:
+        for mod in modules:
+            kept, sup = _lint_one_module(mod, rules, ctx,
+                                         report_unused_suppressions)
+            findings.extend(kept)
+            suppressed += sup
     if _stats is not None:
         _stats["suppressed"] = suppressed
     return sorted(findings, key=Finding.sort_key)
@@ -201,7 +264,8 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
              rules: Optional[List[Rule]] = None,
              baseline: Optional[Dict[str, int]] = None,
              report_unused_suppressions: bool = True,
-             api_surface=_UNSET, mesh_manifest=_UNSET) -> LintResult:
+             api_surface=_UNSET, mesh_manifest=_UNSET,
+             jobs: int = 1) -> LintResult:
     t0 = time.perf_counter()
     root = root or os.getcwd()
     files = iter_python_files(paths)
@@ -234,7 +298,7 @@ def run_lint(paths: Sequence[str], root: Optional[str] = None,
     all_findings = errors + lint_modules(
         modules, rules, report_unused_suppressions=report_unused_suppressions,
         context_modules=context_modules, api_surface=api_surface,
-        mesh_manifest=mesh_manifest, _stats=stats)
+        mesh_manifest=mesh_manifest, jobs=jobs, _stats=stats)
     active, baselined = apply_baseline(all_findings, baseline or {})
     checked = sorted({m.relpath for m in modules} | {e.path for e in errors})
     return LintResult(findings=active, baselined=baselined,

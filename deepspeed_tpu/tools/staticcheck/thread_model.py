@@ -1,0 +1,827 @@
+"""Cross-module thread model for the concurrency rules (threadcheck).
+
+The serving stack has a real thread plane — the ``ThreadingHTTPServer`` ops
+endpoints, the async checkpoint writer, the daemon collective-timeout worker
+in ``comm/comm.py`` — whose safety rests on hand-enforced conventions (the
+``OpsCache`` "GIL-atomic whole-string assignment" contract, the "handlers
+never touch the engine" scrape rule).  This module gives the rules a static
+model of that plane:
+
+- **thread roots** — every function another thread can enter:
+  ``threading.Thread(target=...)`` / ``Timer`` targets, ``Executor.submit``
+  callables, methods of HTTP handler classes (``BaseHTTPRequestHandler``
+  subclasses — the stdlib server spawns a thread per request), callbacks
+  handed to ``register_collector``, and ``signal.signal`` handlers (their own
+  plane: signals are main-thread *reentrancy*, not parallelism, so they feed
+  only the handler-holds-engine rule, never the data-race rules);
+- **reachability** — which functions each root can reach through a
+  conservative name-based call graph (``self.m()`` through the class/base
+  table, bare names through lexical scoping, ``obj.m()`` through light type
+  inference from constructor assignments / annotations / parameter
+  annotations);
+- **attribute access events** — every read / whole-attribute rebind /
+  augmented assignment / in-place container mutation of ``self.x`` (or a
+  typed object's ``x``), keyed ``(ClassName, attr)``, each stamped with the
+  set of locks held at that point (``with`` statements over
+  ``threading.Lock``-typed attributes / module constants);
+- **lock-order edges** — nested acquisitions, aggregated project-wide.
+
+Everything is pure AST (the analyzer keeps working when the library is broken
+at import time) and conservative: what cannot be resolved statically is
+dropped, never guessed — the rules only fire on facts the model proved.
+"""
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .context import (ModuleInfo, annotate_parents, enclosing, param_names,
+                      parent, terminal_name as _terminal_name)
+
+FuncKey = Tuple[str, str]  # (relpath, qualname)
+
+# thread-creation callables whose target is a thread entrypoint
+THREAD_CTOR_NAMES = {"Thread", "Timer"}
+# Executor.submit(fn, ...) — the pool runs fn on a worker thread
+SUBMIT_METHOD = "submit"
+# stdlib socketserver/http.server handler bases: the threading server mixes
+# in one thread per request, so EVERY method of a subclass is thread-entered
+HANDLER_BASE_NAMES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                      "CGIHTTPRequestHandler", "BaseRequestHandler",
+                      "StreamRequestHandler", "DatagramRequestHandler"}
+# MetricsRegistry.register_collector(fn): "collectors run on the OWNING
+# thread" is the documented contract — registration makes fn thread-visible
+COLLECTOR_REGISTER_NAME = "register_collector"
+
+# lock-object constructors (threading module) — an attribute/constant
+# assigned from one of these is a lock for span tracking and lock identity
+LOCK_CTOR_NAMES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# attribute types whose cross-thread use is sanctioned (internally
+# synchronized, or a synchronization primitive itself) — exempt from the
+# data-race rules
+THREADSAFE_TYPE_NAMES = LOCK_CTOR_NAMES | {
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "deque",
+    "Event", "Barrier", "local", "ThreadPoolExecutor"}
+
+# in-place mutation methods on containers — a publish must be a whole-
+# attribute rebind, never one of these on a shared object
+MUTATING_METHODS = {"append", "appendleft", "extend", "insert", "remove",
+                    "pop", "popleft", "clear", "update", "setdefault",
+                    "add", "discard", "sort", "reverse", "popitem",
+                    "__setitem__"}
+
+# provably-mutable constructor spellings for rebind values
+MUTABLE_CTOR_NAMES = {"dict", "list", "set", "bytearray", "defaultdict",
+                      "OrderedDict", "Counter"}
+
+# "engine/manager" identification for handler-holds-engine: a class is
+# engine-like when it defines a train/serve hot-path method, or pairs an
+# Engine-ish name with step(), or a Manager/Supervisor/Router name with a
+# serving verb — mirrors the host-sync rule's hot-path vocabulary
+ENGINE_HOT_METHODS = {"train_batch", "eval_batch", "decode_burst",
+                      "train_step"}
+ENGINE_NAME_FRAGMENT = "Engine"
+MANAGER_NAME_SUFFIXES = ("Manager", "Supervisor", "Router")
+MANAGER_VERBS = {"serve", "step", "put"}
+
+ROOT_KINDS = ("thread", "handler", "collector", "signal")
+
+
+def _annotation_type(node: Optional[ast.AST]) -> Optional[str]:
+    """Terminal class name of an annotation (``OpsCache``, ``x.OpsCache``,
+    ``"OpsCache"``); parameterized/complex annotations resolve to None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split(".")[-1].strip() or None
+    return _terminal_name(node)
+
+
+def _ctor_type(value: ast.AST) -> Optional[str]:
+    """Class name when ``value`` is a plain ``T(...)`` construction."""
+    if isinstance(value, ast.Call):
+        return _terminal_name(value.func)
+    return None
+
+
+@dataclasses.dataclass
+class ClassFacts:
+    name: str
+    relpath: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]
+    methods: Dict[str, FuncKey]
+    attr_types: Dict[str, str]  # attr -> terminal class name, when inferred
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    key: FuncKey
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    relpath: str
+    cls: Optional[str]  # lexically-enclosing class name, if any
+    name: str
+    callees: List[Tuple] = dataclasses.field(default_factory=list)
+    resolved_callees: Set[FuncKey] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class AttrEvent:
+    owner: str  # class name owning the attribute
+    attr: str
+    kind: str  # read | rebind | augassign | mutcall | substore | delete
+    func: FuncKey
+    relpath: str
+    node: ast.AST
+    locks: FrozenSet[str]
+    in_init: bool  # inside the owner's own __init__ (pre-publication)
+    value: Optional[ast.AST] = None  # assigned expression, for rebinds
+
+WRITE_KINDS = {"rebind", "augassign", "substore", "delete"}
+INPLACE_KINDS = {"mutcall", "substore", "delete"}
+
+
+@dataclasses.dataclass
+class ThreadRoot:
+    key: Optional[FuncKey]  # None when the target could not be resolved
+    kind: str  # one of ROOT_KINDS
+    relpath: str
+    node: ast.AST  # the site (Thread call / handler classdef / register call)
+    label: str
+
+
+@dataclasses.dataclass
+class BlockingCall:
+    func: FuncKey
+    relpath: str
+    node: ast.AST
+    what: str  # e.g. "time.sleep", "Thread.join", "subprocess.run"
+    locks: FrozenSet[str]
+
+
+@dataclasses.dataclass
+class LockEdge:
+    outer: str
+    inner: str
+    func: FuncKey
+    relpath: str
+    node: ast.AST  # the INNER acquisition site
+
+
+class ThreadModel:
+    """Project-wide thread-plane facts shared by the concurrency rules."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.classes: Dict[str, ClassFacts] = {}
+        self.functions: Dict[FuncKey, FunctionFacts] = {}
+        self.roots: List[ThreadRoot] = []
+        self.attr_events: Dict[Tuple[str, str], List[AttrEvent]] = {}
+        self.blocking_calls: List[BlockingCall] = []
+        self.lock_edges: List[LockEdge] = []
+        self.engine_refs: Dict[FuncKey, List[Tuple[ast.AST, str]]] = {}
+        # lock identity -> True for every lock the model recognized
+        self.lock_ids: Set[str] = set()
+        self._fn_by_id: Dict[int, FunctionFacts] = {}
+        self._module_lock_consts: Dict[str, Set[str]] = {}
+        # relpath -> {local name: "defining_relpath:NAME"} from-imports,
+        # giving imported module-level locks their defining identity
+        self._import_aliases: Dict[str, Dict[str, str]] = {}
+
+        for mod in modules:
+            # idempotent; ProjectContext annotates too, but the model must
+            # also stand alone (tests, tooling)
+            annotate_parents(mod.tree)
+            self._collect_structure(mod)
+        self._finish_attr_types()
+        self.engine_classes = {c.name for c in self.classes.values()
+                               if self._engine_like(c)}
+        for mod in modules:
+            self._collect_bodies(mod)
+        self._resolve_callees()
+        self._collect_roots(modules)
+        self.thread_reachable: Set[FuncKey] = self._reach(
+            {"thread", "handler", "collector"})
+        self.signal_reachable: Set[FuncKey] = self._reach({"signal"})
+        self._collect_engine_refs()
+
+    # ------------------------------------------------------------- structure
+    def _collect_structure(self, mod: ModuleInfo) -> None:
+        lock_consts = self._module_lock_consts.setdefault(mod.relpath, set())
+        aliases = self._import_aliases.setdefault(mod.relpath, {})
+        for node in mod.tree.body:
+            # module-level LOCK = threading.Lock()
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    _ctor_type(node.value) in LOCK_CTOR_NAMES:
+                lock_consts.add(node.targets[0].id)
+                self.lock_ids.add(f"{mod.relpath}:{node.targets[0].id}")
+            # from pkg.mod import LOCK [as L] — same lock identity as the
+            # defining module's (cross-module lock-order depends on this)
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.level == 0:
+                src = node.module.replace(".", "/") + ".py"
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{src}:{a.name}"
+
+        def visit(node: ast.AST, qual: str, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    cname = child.name
+                    cqual = f"{qual}{cname}" if not qual else f"{qual}.{cname}"
+                    bases = tuple(b for b in
+                                  (_terminal_name(x) for x in child.bases)
+                                  if b is not None)
+                    facts = ClassFacts(name=cname, relpath=mod.relpath,
+                                       node=child, bases=bases, methods={},
+                                       attr_types={})
+                    # first definition wins on a (rare) cross-module name
+                    # collision — conservative, and class names here are
+                    # project-unique by convention
+                    self.classes.setdefault(cname, facts)
+                    for stmt in child.body:
+                        if isinstance(stmt, ast.AnnAssign) and \
+                                isinstance(stmt.target, ast.Name):
+                            t = _annotation_type(stmt.annotation)
+                            if t is not None:
+                                facts.attr_types.setdefault(stmt.target.id, t)
+                    visit(child, cqual, cname)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fqual = f"{qual}.{child.name}" if qual else child.name
+                    key = (mod.relpath, fqual)
+                    facts = FunctionFacts(key=key, node=child,
+                                          relpath=mod.relpath, cls=cls,
+                                          name=child.name)
+                    self.functions[key] = facts
+                    self._fn_by_id[id(child)] = facts
+                    if cls is not None and cls in self.classes and \
+                            self.classes[cls].node is enclosing(
+                                child, ast.ClassDef):
+                        self.classes[cls].methods.setdefault(child.name, key)
+                    visit(child, fqual, cls)
+                else:
+                    visit(child, qual, cls)
+
+        visit(mod.tree, "", None)
+
+    def _finish_attr_types(self) -> None:
+        """``self.a = T(...)`` / ``self.a: T`` inside any method of C types
+        C's attribute ``a`` — the seam that lets a handler's annotated local
+        (``cache: OpsCache = ...``) join the owning class's attribute table."""
+        for fn in self.functions.values():
+            if fn.cls is None or fn.cls not in self.classes:
+                continue
+            facts = self.classes[fn.cls]
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    tgt, val = node.target, node.value
+                else:
+                    continue
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    t = _ctor_type(val)
+                    if t is not None and (t in self.classes or
+                                          t in THREADSAFE_TYPE_NAMES):
+                        facts.attr_types.setdefault(tgt.attr, t)
+                    if t in LOCK_CTOR_NAMES:
+                        self.lock_ids.add(f"{fn.cls}.{tgt.attr}")
+
+    def _engine_like(self, c: ClassFacts) -> bool:
+        methods = set(c.methods) | {
+            m for b in self._base_chain(c.name)
+            for m in self.classes[b].methods if b in self.classes}
+        if methods & ENGINE_HOT_METHODS:
+            return True
+        if ENGINE_NAME_FRAGMENT in c.name and "step" in methods:
+            return True
+        return c.name.endswith(MANAGER_NAME_SUFFIXES) and \
+            bool(methods & MANAGER_VERBS)
+
+    def _base_chain(self, cname: str) -> List[str]:
+        out, seen, todo = [], set(), [cname]
+        while todo:
+            cur = todo.pop()
+            if cur in seen or cur not in self.classes:
+                continue
+            seen.add(cur)
+            out.append(cur)
+            todo.extend(self.classes[cur].bases)
+        return out
+
+    def resolve_method(self, cname: str, method: str) -> Optional[FuncKey]:
+        for c in self._base_chain(cname):
+            key = self.classes[c].methods.get(method)
+            if key is not None:
+                return key
+        return None
+
+    # ---------------------------------------------------------------- bodies
+    def _collect_bodies(self, mod: ModuleInfo) -> None:
+        for fn in self.functions.values():
+            if fn.relpath != mod.relpath:
+                continue
+            _BodyScanner(self, mod, fn).run()
+
+    def _resolve_callees(self) -> None:
+        for fn in self.functions.values():
+            for callee in fn.callees:
+                key = self._resolve_callee(fn, callee)
+                if key is not None:
+                    fn.resolved_callees.add(key)
+
+    def _resolve_callee(self, fn: FunctionFacts, callee: Tuple) -> Optional[FuncKey]:
+        kind = callee[0]
+        if kind == "self" and fn.cls is not None:
+            return self.resolve_method(fn.cls, callee[1])
+        if kind == "typed":
+            return self.resolve_method(callee[1], callee[2])
+        if kind == "bare":
+            return self._resolve_bare(fn.relpath, fn.node, callee[1])
+        return None
+
+    def _resolve_bare(self, relpath: str, from_node: ast.AST,
+                      name: str) -> Optional[FuncKey]:
+        """Nested def in an enclosing function, else a module-level def in
+        the same module.  Imported/aliased callables resolve to None."""
+        scope = from_node
+        while scope is not None:
+            for child in ast.iter_child_nodes(scope):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and child.name == name and id(child) in self._fn_by_id:
+                    return self._fn_by_id[id(child)].key
+            scope = enclosing(scope, ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Module)
+        return None
+
+    # ----------------------------------------------------------------- roots
+    def _collect_roots(self, modules: List[ModuleInfo]) -> None:
+        for mod in modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._handler_class_roots(mod, node)
+                if not isinstance(node, ast.Call):
+                    continue
+                t = _terminal_name(node.func)
+                target: Optional[ast.AST] = None
+                kind = None
+                if t in THREAD_CTOR_NAMES:
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target, kind = kw.value, "thread"
+                    if target is None and t == "Timer" and len(node.args) >= 2:
+                        target, kind = node.args[1], "thread"
+                elif t == SUBMIT_METHOD and isinstance(node.func, ast.Attribute) \
+                        and node.args:
+                    target, kind = node.args[0], "thread"
+                elif t == COLLECTOR_REGISTER_NAME and node.args:
+                    target, kind = node.args[0], "collector"
+                elif t == "signal" and isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "signal" and len(node.args) >= 2:
+                    target, kind = node.args[1], "signal"
+                if target is None or kind is None:
+                    continue
+                key = self._resolve_target(mod, node, target)
+                self.roots.append(ThreadRoot(
+                    key=key, kind=kind, relpath=mod.relpath, node=node,
+                    label=f"{ast.unparse(node.func)}(...) at "
+                          f"{mod.relpath}:{node.lineno}"))
+
+    def _handler_class_roots(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        chain = self._base_chain(node.name)
+        bases = set()
+        for c in chain:
+            bases |= set(self.classes[c].bases)
+        bases |= {b for b in (_terminal_name(x) for x in node.bases) if b}
+        if not (bases & HANDLER_BASE_NAMES):
+            return
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                facts = self._fn_by_id.get(id(stmt))
+                if facts is not None:
+                    self.roots.append(ThreadRoot(
+                        key=facts.key, kind="handler", relpath=mod.relpath,
+                        node=stmt,
+                        label=f"HTTP handler {node.name}.{stmt.name} at "
+                              f"{mod.relpath}:{stmt.lineno}"))
+
+    def _resolve_target(self, mod: ModuleInfo, site: ast.AST,
+                        target: ast.AST) -> Optional[FuncKey]:
+        # self._worker  /  self.obj.method
+        if isinstance(target, ast.Attribute):
+            base = target.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                fn = self._enclosing_function(site)
+                if fn is not None and fn.cls is not None:
+                    return self.resolve_method(fn.cls, target.attr)
+            owner = self._typed_owner(mod, site, base)
+            if owner is not None:
+                return self.resolve_method(owner, target.attr)
+            return None
+        if isinstance(target, ast.Name):
+            fn = self._enclosing_function(site)
+            from_node = fn.node if fn is not None else mod.tree
+            return self._resolve_bare(mod.relpath, from_node, target.id)
+        return None  # lambda / call result: unresolved, skipped
+
+    def _enclosing_function(self, node: ast.AST) -> Optional[FunctionFacts]:
+        cur = enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef)
+        while cur is not None:
+            facts = self._fn_by_id.get(id(cur))
+            if facts is not None:
+                return facts
+            cur = enclosing(cur, ast.FunctionDef, ast.AsyncFunctionDef)
+        return None
+
+    def _typed_owner(self, mod: ModuleInfo, site: ast.AST,
+                     base: ast.AST) -> Optional[str]:
+        """Class name of ``base`` when the enclosing scope types it (used by
+        root-target resolution; body-level typing lives in _BodyScanner)."""
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and base.value.id == "self":
+            fn = self._enclosing_function(site)
+            if fn is not None and fn.cls in self.classes:
+                return self.classes[fn.cls].attr_types.get(base.attr)
+        return None
+
+    # ---------------------------------------------------------- reachability
+    def _reach(self, kinds: Set[str]) -> Set[FuncKey]:
+        seen: Set[FuncKey] = set()
+        todo = [r.key for r in self.roots if r.kind in kinds and r.key]
+        while todo:
+            key = todo.pop()
+            if key in seen or key not in self.functions:
+                continue
+            seen.add(key)
+            todo.extend(self.functions[key].resolved_callees)
+        return seen
+
+    def root_for(self, key: FuncKey, kinds: Iterable[str]) -> Optional[ThreadRoot]:
+        """A root (of the given kinds) that reaches ``key`` — for messages."""
+        for r in self.roots:
+            if r.kind not in kinds or r.key is None:
+                continue
+            seen, todo = set(), [r.key]
+            while todo:
+                cur = todo.pop()
+                if cur == key:
+                    return r
+                if cur in seen or cur not in self.functions:
+                    continue
+                seen.add(cur)
+                todo.extend(self.functions[cur].resolved_callees)
+        return None
+
+    # --------------------------------------------------------- engine lookup
+    def _collect_engine_refs(self) -> None:
+        for fn in self.functions.values():
+            refs: List[Tuple[ast.AST, str]] = []
+            own_engine = fn.cls in self.engine_classes
+            types = _local_types(self, fn)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load):
+                    if node.id == "self" and own_engine:
+                        refs.append((node, fn.cls))
+                    elif types.get(node.id) in self.engine_classes:
+                        refs.append((node, types[node.id]))
+                elif isinstance(node, ast.Attribute) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == "self" and fn.cls in self.classes:
+                    t = self.classes[fn.cls].attr_types.get(node.attr)
+                    if t in self.engine_classes:
+                        refs.append((node, t))
+            if refs:
+                refs.sort(key=lambda r: (r[0].lineno, r[0].col_offset))
+                self.engine_refs[fn.key] = refs
+
+    # -------------------------------------------------------------- plumbing
+    def add_event(self, ev: AttrEvent) -> None:
+        self.attr_events.setdefault((ev.owner, ev.attr), []).append(ev)
+
+    def attr_type(self, owner: str, attr: str) -> Optional[str]:
+        c = self.classes.get(owner)
+        if c is None:
+            return None
+        for name in self._base_chain(owner):
+            t = self.classes[name].attr_types.get(attr)
+            if t is not None:
+                return t
+        return None
+
+    def is_threadsafe_attr(self, owner: str, attr: str) -> bool:
+        t = self.attr_type(owner, attr)
+        return t in THREADSAFE_TYPE_NAMES
+
+    def plane_of(self, key: FuncKey) -> str:
+        """'thread' | 'signal' | 'main' — signal-only functions are their own
+        plane (reentrancy, not parallelism) and never join the race rules."""
+        if key in self.thread_reachable:
+            return "thread"
+        if key in self.signal_reachable:
+            return "signal"
+        return "main"
+
+
+def _local_types(model: ThreadModel, fn: FunctionFacts) -> Dict[str, str]:
+    """name -> class name for typed locals/params of ``fn`` (constructor
+    assignments, annotated assignments, parameter annotations)."""
+    types: Dict[str, str] = {}
+    args = fn.node.args
+    for a in list(getattr(args, "posonlyargs", [])) + list(args.args) + \
+            list(args.kwonlyargs):
+        t = _annotation_type(a.annotation)
+        if t is not None:
+            types[a.arg] = t
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            t = _ctor_type(node.value)
+            if t is not None and (t in model.classes or
+                                  t in THREADSAFE_TYPE_NAMES):
+                types.setdefault(node.targets[0].id, t)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            t = _annotation_type(node.annotation)
+            if t is not None:
+                types.setdefault(node.target.id, t)
+    return types
+
+
+# blocking-call classification for blocking-under-lock
+_SLEEP_MODULES = {"time", "gevent"}
+_SUBPROCESS_FNS = {"run", "check_call", "check_output", "call", "Popen"}
+_COLLECTIVE_FNS = {"all_reduce", "all_gather", "allreduce", "allgather",
+                   "barrier", "broadcast", "reduce_scatter", "psum", "pmean",
+                   "bounded_collective"}
+_JOINABLE_TYPES = {"Thread", "Timer", "Queue", "JoinableQueue",
+                   "ThreadPoolExecutor", "Process"}
+_JOINABLE_NAME_HINTS = ("thread", "worker", "proc")
+
+
+class _BodyScanner:
+    """One function body: attribute events, lock spans, blocking calls,
+    nested-acquisition edges, and the (unresolved) callee list."""
+
+    def __init__(self, model: ThreadModel, mod: ModuleInfo, fn: FunctionFacts):
+        self.model = model
+        self.mod = mod
+        self.fn = fn
+        self.types = _local_types(model, fn)
+        self.lock_aliases: Dict[str, str] = {}  # local name -> lock id
+        self.held: List[str] = []
+        self.in_init = fn.name == "__init__"
+        self.nested = {id(n) for n in ast.walk(fn.node)
+                       if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.Lambda)) and n is not fn.node}
+
+    def run(self) -> None:
+        for stmt in self.fn.node.body:
+            self._stmt(stmt)
+
+    # --------------------------------------------------------------- helpers
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        """Stable identity of a lock expression, else None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and self.fn.cls is not None:
+            lid = f"{self.fn.cls}.{expr.attr}"
+            return lid if lid in self.model.lock_ids else None
+        if isinstance(expr, ast.Name):
+            alias = self.lock_aliases.get(expr.id)
+            if alias is not None:
+                return alias
+            lid = f"{self.fn.relpath}:{expr.id}"
+            if expr.id in self.model._module_lock_consts.get(
+                    self.fn.relpath, ()):
+                return lid
+            imported = self.model._import_aliases.get(
+                self.fn.relpath, {}).get(expr.id)
+            if imported is not None and imported in self.model.lock_ids:
+                return imported
+            if self.types.get(expr.id) in LOCK_CTOR_NAMES:
+                # function-local lock: identity scoped to this function
+                return f"{self.fn.key[0]}:{self.fn.key[1]}:{expr.id}"
+        return None
+
+    def _owner_of(self, base: ast.AST) -> Optional[str]:
+        """Class owning an attribute access rooted at ``base``."""
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return self.fn.cls
+            return self.types.get(base.id)
+        return None
+
+    # ------------------------------------------------------------ statements
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are their own FunctionFacts
+        if isinstance(stmt, ast.With) or isinstance(stmt, ast.AsyncWith):
+            acquired: List[str] = []
+            for item in stmt.items:
+                lid = self._lock_id(item.context_expr)
+                self._expr(item.context_expr)
+                if lid is not None:
+                    for outer in self.held + acquired:
+                        if outer != lid:
+                            self.model.lock_edges.append(LockEdge(
+                                outer=outer, inner=lid, func=self.fn.key,
+                                relpath=self.fn.relpath,
+                                node=item.context_expr))
+                    acquired.append(lid)
+            self.held.extend(acquired)
+            for inner in stmt.body:
+                self._stmt(inner)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            # single-level lock aliasing: lk = self._lock / lk = _LOCK
+            if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+                lid = self._lock_id(stmt.value)
+                if lid is not None:
+                    self.lock_aliases[stmt.targets[0].id] = lid
+            for tgt in stmt.targets:
+                self._target(tgt, value=stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            owner = None
+            if isinstance(stmt.target, ast.Attribute):
+                owner = self._owner_of(stmt.target.value)
+                if owner is not None:
+                    self._event(owner, stmt.target.attr, "augassign",
+                                stmt.target)
+            if owner is None and isinstance(stmt.target, ast.Subscript):
+                self._target(stmt.target, value=stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            self._target(stmt.target, value=stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Attribute):
+                    owner = self._owner_of(tgt.value)
+                    if owner is not None:
+                        self._event(owner, tgt.attr, "delete", tgt)
+                elif isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Attribute):
+                    owner = self._owner_of(tgt.value.value)
+                    if owner is not None:
+                        self._event(owner, tgt.value.attr, "delete", tgt)
+            return
+        # generic statement (if/for/while/try/expr/return/...): child
+        # statements recurse (keeping the held-lock stack correct through
+        # compound bodies), child expressions are scanned for events
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.ExceptHandler):
+                for inner in child.body:
+                    self._stmt(inner)
+
+    def _target(self, tgt: ast.AST, value: Optional[ast.AST]) -> None:
+        if isinstance(tgt, ast.Attribute):
+            owner = self._owner_of(tgt.value)
+            if owner is not None:
+                self._event(owner, tgt.attr, "rebind", tgt, value=value)
+        elif isinstance(tgt, ast.Subscript):
+            if isinstance(tgt.value, ast.Attribute):
+                owner = self._owner_of(tgt.value.value)
+                if owner is not None:
+                    self._event(owner, tgt.value.attr, "substore", tgt)
+            self._expr(tgt.slice)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._target(el, value=None)
+
+    # ----------------------------------------------------------- expressions
+    def _expr(self, expr: ast.AST) -> None:
+        for node in self._walk_own(expr):
+            if isinstance(node, ast.Call):
+                self._call(node)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                owner = self._owner_of(node.value)
+                if owner is None:
+                    continue
+                up = parent(node)
+                if isinstance(up, ast.Attribute) or (
+                        isinstance(up, ast.Call) and up.func is node):
+                    continue  # handled at the call / outer attribute
+                self._event(owner, node.attr, "read", node)
+
+    def _walk_own(self, root: ast.AST):
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if id(node) in self.nested and node is not root:
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _call(self, call: ast.Call) -> None:
+        f = call.func
+        t = _terminal_name(f)
+        # ---- callee recording
+        if isinstance(f, ast.Name):
+            self.fn.callees.append(("bare", f.id))
+        elif isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                self.fn.callees.append(("self", f.attr))
+            else:
+                owner = self._owner_of(base)
+                if owner is not None:
+                    self.fn.callees.append(("typed", owner, f.attr))
+                if isinstance(base, ast.Attribute):
+                    # self.obj.method(...): typed through the attr table
+                    aowner = self._owner_of(base.value)
+                    if aowner is not None:
+                        atype = self.model.attr_type(aowner, base.attr)
+                        if atype is not None:
+                            self.fn.callees.append(("typed", atype, f.attr))
+        # ---- attribute events through calls: self.attr.mutate(...)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Attribute):
+            owner = self._owner_of(f.value.value)
+            if owner is not None:
+                kind = "mutcall" if t in MUTATING_METHODS else "read"
+                self._event(owner, f.value.attr, kind, f.value)
+        # ---- blocking calls while a lock is held
+        if self.held:
+            what = self._blocking_kind(call, t)
+            if what is not None:
+                self.model.blocking_calls.append(BlockingCall(
+                    func=self.fn.key, relpath=self.fn.relpath, node=call,
+                    what=what, locks=frozenset(self.held)))
+
+    def _blocking_kind(self, call: ast.Call, t: Optional[str]) -> Optional[str]:
+        f = call.func
+        if t == "sleep":
+            if isinstance(f, ast.Name):
+                return "sleep"
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in _SLEEP_MODULES:
+                return f"{f.value.id}.sleep"
+            return None
+        if t in _SUBPROCESS_FNS:
+            if t == "Popen" and isinstance(f, ast.Name):
+                return "Popen"
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "subprocess":
+                return f"subprocess.{t}"
+            return None
+        if t == "fsync":
+            return "os.fsync"
+        if t in _COLLECTIVE_FNS:
+            return f"collective entry {t}()"
+        if t in ("block_until_ready", "device_get"):
+            return f"device sync {t}()"
+        if t == "join" and isinstance(f, ast.Attribute):
+            recv = f.value
+            rtype = None
+            if isinstance(recv, ast.Name):
+                rtype = self.types.get(recv.id)
+            elif isinstance(recv, ast.Attribute):
+                owner = self._owner_of(recv.value)
+                if owner is not None:
+                    rtype = self.model.attr_type(owner, recv.attr)
+            if rtype in _JOINABLE_TYPES:
+                return f"{rtype}.join"
+            text = ast.unparse(recv).lower()
+            if rtype is None and any(h in text for h in _JOINABLE_NAME_HINTS):
+                return "join"
+        return None
+
+    def _event(self, owner: str, attr: str, kind: str, node: ast.AST,
+               value: Optional[ast.AST] = None) -> None:
+        self.model.add_event(AttrEvent(
+            owner=owner, attr=attr, kind=kind, func=self.fn.key,
+            relpath=self.fn.relpath, node=node,
+            locks=frozenset(self.held),
+            in_init=self.in_init and self.fn.cls == owner, value=value))
+
+
+def is_mutable_value(expr: Optional[ast.AST]) -> bool:
+    """Provably-mutable rebind values: container literals/comprehensions and
+    bare mutable-constructor calls.  Names/attributes/call results are NOT
+    provably mutable — the model never guesses."""
+    if expr is None:
+        return False
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.SetComp, ast.DictComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        return _terminal_name(expr.func) in MUTABLE_CTOR_NAMES
+    return False

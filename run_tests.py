@@ -475,6 +475,111 @@ def ops_smoke():
     return 0
 
 
+def ops_stress():
+    """Dynamic validation of the conventions the threadcheck lint encodes
+    (ISSUE 18): hammer /metrics + /healthz + direct ``health()`` calls from
+    N concurrent threads for the WHOLE duration of a mixed serve and assert
+    (a) every response strict-parses (no torn reads of the published cache
+    strings — the atomic-publish contract observed dynamically), (b) zero
+    exceptions escape any hammer thread, and (c) the fastpath
+    ``ServeCounters`` snapshot is byte-identical to an unscraped run — the
+    scrape plane added no host-link traffic (the handler-holds-engine
+    contract observed dynamically)."""
+    import os
+    import threading
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.monitor.exposition import parse_exposition
+    from deepspeed_tpu.monitor.ops_server import scrape
+
+    N_SCRAPERS = 4   # /metrics + /healthz hammer threads
+    N_HEALTH = 2     # direct engine.health() hammer threads
+
+    cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                                 kv_heads=2, seq=256)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=64, block_size=8, max_blocks_per_seq=8,
+              token_budget=32, max_seqs_per_step=8)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, 128, int(n)).tolist()
+               for n in rng.integers(4, 16, 8)]
+
+    on = InferenceEngineV2(llama, cfg, params,
+                           config={"dtype": "float32",
+                                   "serving_tracing": {"enabled": True},
+                                   "ops_server": {"enabled": True,
+                                                  "refresh_interval_s": 0.0}},
+                           **kw)
+    off = InferenceEngineV2(llama, cfg, params,
+                            config={"dtype": "float32",
+                                    "serving_tracing": {"enabled": True}}, **kw)
+    url = on.ops.url
+
+    stop = threading.Event()
+    stats = {"metrics": 0, "healthz": 0, "health": 0}
+    stats_lock = threading.Lock()
+    errors = []  # (worker label, repr(exc)) — any entry fails the stress
+
+    def scraper(idx):
+        try:
+            while not stop.is_set():
+                fams = parse_exposition(scrape(url("/metrics")))
+                assert "dstpu_serving_completed_total" in fams
+                hz = json.loads(scrape(url("/healthz")))
+                assert isinstance(hz, dict)
+                with stats_lock:
+                    stats["metrics"] += 1
+                    stats["healthz"] += 1
+        except BaseException as exc:
+            errors.append((f"scraper-{idx}", repr(exc)))
+
+    def health_hammer(idx):
+        try:
+            while not stop.is_set():
+                h = on.health()
+                # health() must always be a complete, JSON-renderable view
+                json.dumps(h)
+                assert "latency" in h
+                with stats_lock:
+                    stats["health"] += 1
+        except BaseException as exc:
+            errors.append((f"health-{idx}", repr(exc)))
+
+    threads = [threading.Thread(target=scraper, args=(i,), daemon=True)
+               for i in range(N_SCRAPERS)]
+    threads += [threading.Thread(target=health_hammer, args=(i,), daemon=True)
+                for i in range(N_HEALTH)]
+    for t in threads:
+        t.start()
+    out_on = on.generate(prompts, max_new_tokens=8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads), "hammer thread hung"
+    assert not errors, f"hammer thread failures: {errors}"
+    assert stats["metrics"] > 0 and stats["health"] > 0, \
+        f"stress produced no load: {stats}"
+
+    # the scrape plane must not have perturbed the serve: tokens AND
+    # host-link counters byte-identical to the unscraped engine
+    out_off = off.generate(prompts, max_new_tokens=8)
+    assert out_on == out_off, "stress changed the served tokens"
+    c_on, c_off = on.counters.snapshot(), off.counters.snapshot()
+    assert c_on == c_off, \
+        f"stress disturbed the host-link counters: {c_on} vs {c_off}"
+
+    on.close_ops()
+    print(json.dumps({"ops_stress": "ok", "requests": len(prompts),
+                      "threads": len(threads), **stats,
+                      "host_syncs": c_on["host_syncs"]}))
+    return 0
+
+
 def kv_obs_smoke():
     """CI smoke for KV-pool observability (ISSUE 12 acceptance): (a) a
     shared-prefix serve must report a NON-ZERO counterfactual prefix-cache
@@ -1485,6 +1590,7 @@ def main():
              run_smoke_lane("serving_fastpath_smoke", "--serving-fastpath-smoke"),
              run_smoke_lane("tracing_smoke", "--tracing-smoke"),
              run_smoke_lane("ops_smoke", "--ops-smoke"),
+             run_smoke_lane("ops_stress", "--ops-stress-smoke"),
              run_smoke_lane("kv_obs_smoke", "--kv-obs-smoke"),
              run_smoke_lane("prefix_cache_smoke", "--prefix-cache-smoke"),
              run_smoke_lane("serving_recovery_smoke", "--serving-recovery-smoke"),
@@ -1514,6 +1620,8 @@ if __name__ == "__main__":
         sys.exit(tracing_smoke())
     if "--ops-smoke" in sys.argv:
         sys.exit(ops_smoke())
+    if "--ops-stress-smoke" in sys.argv:
+        sys.exit(ops_stress())
     if "--kv-obs-smoke" in sys.argv:
         sys.exit(kv_obs_smoke())
     if "--prefix-cache-smoke" in sys.argv:

@@ -123,7 +123,9 @@ def test_list_rules(capsys):
                  "undeclared-config-key", "bad-suppression", "unused-suppression",
                  "unknown-mesh-axis", "sharding-dropped-at-boundary",
                  "spec-rank-mismatch", "recompile-risk",
-                 "donation-sharding-mismatch"):
+                 "donation-sharding-mismatch", "cross-thread-mutation",
+                 "atomic-publish", "handler-holds-engine",
+                 "blocking-under-lock", "lock-order"):
         assert rule in out
 
 
@@ -382,3 +384,117 @@ def test_changed_mode_surfaces_ls_files_failure(tree, capsys, monkeypatch):
     assert rc == 2
     err = capsys.readouterr().err
     assert "ls-files" in err and "index file corrupt" in err
+
+
+# ------------------------------------------- --changed catches thread rules
+THREADED_RACE = textwrap.dedent("""
+    import threading
+
+
+    class Writer:
+        def __init__(self):
+            self._err = None
+            self._t = threading.Thread(target=self._worker)
+
+        def _worker(self):
+            self._err = ValueError("boom")
+
+        def take(self):
+            exc, self._err = self._err, None
+            return exc
+    """)
+
+
+def test_changed_mode_fails_prepush_on_a_thread_rule_finding(tmp_path, capsys):
+    """ISSUE 18 CI contract: a concurrency finding introduced in a TOUCHED
+    file must fail the `--changed` pre-push lane — the thread rules ride the
+    same changed-file scoping as every other rule."""
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (pkg / "worker.py").write_text(CLEAN)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    rc, out = run_cli(["--root", str(tmp_path), "--changed"], capsys)
+    assert rc == 0 and "no python files changed" in out
+    # the touched file now carries the AsyncCheckpointEngine-class race
+    (pkg / "worker.py").write_text(THREADED_RACE)
+    rc, out = run_cli(["--root", str(tmp_path), "--changed"], capsys)
+    assert rc == 1
+    assert "cross-thread-mutation" in out and "worker.py" in out
+
+
+# ----------------------------------------------------------------- --jobs
+def test_jobs_parallel_results_match_sequential(tree, capsys):
+    (tree / "pkg" / "race.py").write_text(THREADED_RACE)
+    rc1, out1 = run_cli([str(tree / "pkg"), "--root", str(tree),
+                         "--format", "json"], capsys)
+    rc2, out2 = run_cli([str(tree / "pkg"), "--root", str(tree),
+                         "--format", "json", "--jobs", "2"], capsys)
+    assert rc1 == rc2 == 1
+    d1, d2 = json.loads(out1), json.loads(out2)
+    for d in (d1, d2):
+        d["summary"].pop("seconds")
+    assert d1 == d2
+    assert {f["rule"] for f in d1["findings"]} == {"silent-except",
+                                                   "cross-thread-mutation"}
+
+
+def test_jobs_zero_means_cpu_count_and_negative_is_usage_error(tree, capsys):
+    rc, _ = run_cli([str(tree / "pkg" / "clean.py"), "--root", str(tree),
+                     "--jobs", "0"], capsys)
+    assert rc == 0
+    assert main([str(tree / "pkg"), "--root", str(tree), "--jobs", "-1"]) == 2
+
+
+# ----------------------------------------------------- --list-suppressions
+SUPPRESSED = textwrap.dedent("""
+    def f():
+        try:
+            g()
+        except Exception:  # dslint: disable=silent-except  # teardown guard
+            pass
+    """)
+
+STALE_SUP = "# dslint: disable-file=silent-except  # nothing to silence\nx = 1\n"
+
+REASONLESS = textwrap.dedent("""
+    def f():
+        try:
+            g()
+        except Exception:  # dslint: disable=silent-except
+            pass
+    """)
+
+
+def test_list_suppressions_reports_reasons_stale_and_reasonless(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "good.py").write_text(SUPPRESSED)
+    (pkg / "stale.py").write_text(STALE_SUP)
+    (pkg / "bad.py").write_text(REASONLESS)
+    rc, out = run_cli([str(pkg), "--root", str(tmp_path),
+                       "--list-suppressions"], capsys)
+    assert rc == 1  # stale + reasonless entries need attention
+    assert "3 suppression(s)" not in out  # reasonless ones are inert, not counted
+    assert "2 suppression(s)" in out and "1 stale" in out
+    assert "teardown guard" in out
+    assert "pkg/stale.py:1 [STALE]" in out
+    assert "pkg/bad.py:5 [NO REASON]" in out
+
+
+def test_list_suppressions_clean_exits_zero(tmp_path, capsys):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "good.py").write_text(SUPPRESSED)
+    rc, out = run_cli([str(pkg), "--root", str(tmp_path),
+                       "--list-suppressions"], capsys)
+    assert rc == 0
+    assert "0 stale, 0 without a reason" in out
+    assert "silent-except (1)" in out
+
+
+def test_list_suppressions_refuses_update_modes(tree):
+    for flag in ("--update-baseline", "--update-api-surface",
+                 "--update-mesh-manifest"):
+        assert main(["--root", str(tree), "--list-suppressions", flag]) == 2

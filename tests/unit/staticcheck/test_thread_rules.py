@@ -1,0 +1,760 @@
+"""Concurrency rules (ISSUE 18): per-rule positive and negative fixtures plus
+thread-model extraction (roots, reachability, planes, lock tracking).
+
+Every positive fixture is distilled from a real in-tree finding the rules
+surfaced on landing:
+
+- ``cross-thread-mutation`` — the ``AsyncCheckpointEngine._error`` race the
+  rule caught (worker-thread store vs. main-thread swap, no common lock —
+  fixed in-tree with ``_error_lock``);
+- ``atomic-publish`` — the ``OpsCache.refreshes`` ``+=`` on the object the
+  handler threads read (suppressed in-tree with the single-writer reason) and
+  the in-place-dict-mutation variant of the same hazard;
+- ``handler-holds-engine`` — the ``Engine._on_preemption`` signal handler
+  (suppressed: the PR-2 preemption-save contract) and the scrape-safety
+  contract ops_server's OpsCache design exists to uphold;
+- ``blocking-under-lock`` / ``lock-order`` — no in-tree instance (the tree
+  has exactly one lock after this PR); the fixtures encode the policy the
+  rules enforce going forward.
+"""
+
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.tools.staticcheck import ThreadModel, lint_source
+from deepspeed_tpu.tools.staticcheck.runner import (iter_python_files,
+                                                    load_modules)
+
+
+def run(src, rules, filename="deepspeed_tpu/mod.py", **kw):
+    return lint_source(textwrap.dedent(src), filename=filename,
+                       rule_names=rules, **kw)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def model_of(src, filename="deepspeed_tpu/mod.py"):
+    import ast
+    from deepspeed_tpu.tools.staticcheck.context import ModuleInfo
+    source = textwrap.dedent(src)
+    mod = ModuleInfo(path=filename, relpath=filename, source=source,
+                     tree=ast.parse(source, filename=filename),
+                     lines=source.splitlines())
+    return ThreadModel([mod])
+
+
+# --------------------------------------------------------------- thread model
+class TestThreadModel:
+    def test_thread_timer_submit_collector_and_signal_roots(self):
+        tm = model_of("""
+            import signal
+            import threading
+            from concurrent.futures import ThreadPoolExecutor
+
+            def work(): pass
+            def tick(): pass
+            def collect(): return []
+            def on_term(signum, frame): pass
+
+            def main():
+                threading.Thread(target=work).start()
+                threading.Timer(1.0, tick).start()
+                ThreadPoolExecutor(1).submit(work)
+                register_collector(collect)
+                signal.signal(signal.SIGTERM, on_term)
+            """)
+        kinds = {(r.kind, r.key[1] if r.key else None) for r in tm.roots}
+        assert ("thread", "work") in kinds
+        assert ("thread", "tick") in kinds
+        assert ("collector", "collect") in kinds
+        assert ("signal", "on_term") in kinds
+
+    def test_handler_class_methods_are_roots(self):
+        tm = model_of("""
+            from http.server import BaseHTTPRequestHandler
+
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    self._send()
+                def _send(self):
+                    pass
+            """)
+        assert any(r.kind == "handler" and r.key[1] == "H.do_GET"
+                   for r in tm.roots)
+        # reachability follows self-calls out of the root
+        key = ("deepspeed_tpu/mod.py", "H._send")
+        assert key in tm.thread_reachable
+        assert tm.plane_of(key) == "thread"
+
+    def test_signal_plane_is_not_the_thread_plane(self):
+        tm = model_of("""
+            import signal
+
+            def on_term(signum, frame):
+                helper()
+            def helper(): pass
+            def main():
+                signal.signal(signal.SIGTERM, on_term)
+            """)
+        helper = ("deepspeed_tpu/mod.py", "helper")
+        assert helper in tm.signal_reachable
+        assert helper not in tm.thread_reachable
+        assert tm.plane_of(helper) == "signal"
+
+    def test_unresolvable_target_drops_to_no_root(self):
+        tm = model_of("""
+            import threading
+
+            class S:
+                def go(self, fn):
+                    threading.Thread(target=fn).start()
+                    threading.Thread(target=self._httpd.serve_forever).start()
+            """)
+        assert all(r.key is None for r in tm.roots)
+
+
+# ----------------------------------------------------- cross-thread-mutation
+class TestCrossThreadMutation:
+    RULE = ["cross-thread-mutation"]
+
+    # distilled AsyncCheckpointEngine._error: worker-thread store vs.
+    # main-thread swap of the same attribute, no lock anywhere
+    RACE = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._err = None
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                self._err = ValueError("boom")
+
+            def take(self):
+                exc, self._err = self._err, None
+                return exc
+        """
+
+    def test_flags_both_sides_of_the_checkpoint_error_race(self):
+        findings = run(self.RACE, self.RULE)
+        assert rules_of(findings) == ["cross-thread-mutation"] * 2
+        assert "_err" in findings[0].message
+        assert "thread-entered via" in findings[0].message
+
+    def test_common_lock_on_both_sides_is_clean(self):
+        findings = run("""
+            import threading
+
+            class Writer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._err = None
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    with self._lock:
+                        self._err = ValueError("boom")
+
+                def take(self):
+                    with self._lock:
+                        exc, self._err = self._err, None
+                    return exc
+            """, self.RULE)
+        assert findings == []
+
+    def test_disjoint_locks_still_race(self):
+        findings = run("""
+            import threading
+
+            class Writer:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._err = None
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    with self._a:
+                        self._err = 1
+
+                def take(self):
+                    with self._b:
+                        self._err = None
+            """, self.RULE)
+        assert rules_of(findings) == ["cross-thread-mutation"] * 2
+
+    def test_augassign_against_other_plane_read_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    self.n += 1
+
+                def snapshot(self):
+                    return self.n
+            """, self.RULE)
+        assert rules_of(findings) == ["cross-thread-mutation"]
+        assert "not atomic even under the GIL" in findings[0].message
+
+    def test_threadsafe_queue_attr_is_exempt(self):
+        findings = run("""
+            import queue
+            import threading
+
+            class Writer:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    self._q.put(1)
+
+                def take(self):
+                    return self._q.get()
+            """, self.RULE)
+        assert findings == []
+
+    def test_init_writes_are_pre_publication_and_exempt(self):
+        findings = run("""
+            import threading
+
+            class Writer:
+                def __init__(self):
+                    self.mode = "idle"
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    print(self.mode)
+            """, self.RULE)
+        assert findings == []
+
+    def test_signal_handler_access_does_not_count_as_a_thread(self):
+        # signal handlers interleave on the main thread (reentrancy, not
+        # parallelism) — they must not light up the race rules
+        findings = run("""
+            import signal
+
+            class Eng:
+                def __init__(self):
+                    self.stop = False
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self.stop = True
+
+                def step(self):
+                    self.stop = False
+            """, self.RULE)
+        assert findings == []
+
+    def test_closure_locals_in_nested_thread_target_are_clean(self):
+        # distilled comm.bounded_collective: the nested _run target mutates
+        # closure LISTS (locals), not attributes — no shared-attr events
+        findings = run("""
+            import threading
+
+            def bounded(fn):
+                result = []
+                def _run():
+                    result.append(fn())
+                t = threading.Thread(target=_run)
+                t.start()
+                t.join()
+                return result[0]
+            """, self.RULE)
+        assert findings == []
+
+
+# ------------------------------------------------------------- atomic-publish
+class TestAtomicPublish:
+    RULE = ["atomic-publish"]
+
+    def test_in_place_dict_store_on_shared_instance_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.stats = {}
+                    self.text = ""
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.text)
+
+                def update(self):
+                    self.stats["hits"] = 1
+            """, self.RULE)
+        assert rules_of(findings) == ["atomic-publish"]
+        assert "in-place mutation" in findings[0].message
+
+    def test_augassign_counter_on_shared_instance_is_flagged(self):
+        # distilled OpsCache.refreshes: the += rides on an object handler
+        # threads read, even though nothing else touches the counter
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.text = ""
+                    self.refreshes = 0
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.text)
+
+                def update(self):
+                    self.text = "ok"
+                    self.refreshes += 1
+            """, self.RULE)
+        assert rules_of(findings) == ["atomic-publish"]
+        assert "refreshes" in findings[0].message
+
+    def test_mutating_method_call_on_shared_attr_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.rows = []
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.rows)
+
+                def update(self):
+                    self.rows.append(1)
+            """, self.RULE)
+        assert rules_of(findings) == ["atomic-publish"]
+
+    def test_publishing_a_fresh_mutable_container_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.snap = ()
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.snap)
+
+                def publish(self):
+                    self.snap = {"a": 1}
+            """, self.RULE)
+        assert rules_of(findings) == ["atomic-publish"]
+        assert "MUTABLE container" in findings[0].message
+
+    def test_whole_string_rebind_is_the_sanctioned_pattern(self):
+        # the OpsCache convention itself: complete immutable strings,
+        # one GIL-atomic pointer store each — clean
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.text = ""
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.text)
+
+                def update(self, rendered):
+                    self.text = rendered
+            """, self.RULE)
+        assert findings == []
+
+    def test_lock_disciplined_mutation_is_exempt(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {}
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    with self._lock:
+                        print(self.stats)
+
+                def update(self):
+                    with self._lock:
+                        self.stats["hits"] = 1
+            """, self.RULE)
+        assert findings == []
+
+    def test_unshared_class_mutates_freely(self):
+        findings = run("""
+            class Plain:
+                def __init__(self):
+                    self.stats = {}
+
+                def update(self):
+                    self.stats["hits"] = 1
+                    self.stats.update(a=2)
+            """, self.RULE)
+        assert findings == []
+
+
+# -------------------------------------------------------- handler-holds-engine
+class TestHandlerHoldsEngine:
+    RULE = ["handler-holds-engine"]
+
+    ENGINE_CTX = """
+        class InferenceEngine:
+            def step(self, reqs):
+                return reqs
+        """
+
+    def test_http_handler_touching_a_typed_engine_is_flagged(self):
+        findings = run("""
+            from http.server import BaseHTTPRequestHandler
+
+            class InferenceEngine:
+                def step(self, reqs):
+                    return reqs
+
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    eng: InferenceEngine = self.server.engine
+                    eng.step([])
+            """, self.RULE)
+        assert rules_of(findings) == ["handler-holds-engine"]
+        assert "HTTP handler" in findings[0].message
+        assert "InferenceEngine" in findings[0].message
+
+    def test_thread_target_method_on_engine_class_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class ServeEngine:
+                def step(self, reqs):
+                    return reqs
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.step([])
+            """, self.RULE)
+        assert rules_of(findings) == ["handler-holds-engine"]
+        assert "thread target" in findings[0].message
+
+    def test_transitive_reach_through_a_helper_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class FleetRouter:
+                def serve(self, req):
+                    return req
+
+            def scrape(router: FleetRouter):
+                router.serve(None)
+
+            def loop():
+                scrape(ROUTER)
+
+            def main():
+                threading.Thread(target=loop).start()
+            """, self.RULE)
+        assert rules_of(findings) == ["handler-holds-engine"]
+        assert "reaches engine/manager class 'FleetRouter'" in \
+            findings[0].message
+
+    def test_signal_handler_on_engine_class_is_flagged(self):
+        # the in-tree Engine._on_preemption shape (suppressed there with the
+        # PR-2 preemption-save contract as the reason)
+        findings = run("""
+            import signal
+
+            class TrainEngine:
+                def train_batch(self, batch):
+                    return batch
+
+                def arm(self):
+                    signal.signal(signal.SIGTERM, self._on_term)
+
+                def _on_term(self, signum, frame):
+                    self.save()
+
+                def save(self):
+                    pass
+            """, self.RULE)
+        assert rules_of(findings) == ["handler-holds-engine"]
+        assert "signal handler" in findings[0].message
+
+    def test_handler_reading_a_prerendered_cache_is_clean(self):
+        # the OpsCache pattern the rule exists to protect
+        findings = run("""
+            from http.server import BaseHTTPRequestHandler
+
+            class OpsCache:
+                def __init__(self):
+                    self.metrics_text = ""
+
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    cache: OpsCache = self.server.ops_cache
+                    self.wfile.write(cache.metrics_text.encode())
+            """, self.RULE)
+        assert findings == []
+
+    def test_worker_thread_on_non_engine_class_is_clean(self):
+        # AsyncCheckpointEngine._worker: "Engine" in the name but no hot
+        # method and no step — not engine-like, self use is fine
+        findings = run("""
+            import threading
+
+            class AsyncCheckpointEngine:
+                def __init__(self):
+                    self._t = threading.Thread(target=self._worker)
+
+                def _worker(self):
+                    self.drain()
+
+                def drain(self):
+                    pass
+            """, self.RULE)
+        assert findings == []
+
+
+# -------------------------------------------------------- blocking-under-lock
+class TestBlockingUnderLock:
+    RULE = ["blocking-under-lock"]
+
+    def test_sleep_under_lock_is_flagged(self):
+        findings = run("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def wait(self):
+                    with self._lock:
+                        time.sleep(0.5)
+            """, self.RULE)
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert "time.sleep" in findings[0].message
+
+    def test_subprocess_and_collective_under_lock_are_flagged(self):
+        findings = run("""
+            import subprocess
+            import threading
+            from deepspeed_tpu import comm as dist
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def snapshot(self):
+                    with self._lock:
+                        subprocess.run(["sync"])
+                        dist.all_reduce(None)
+            """, self.RULE)
+        assert len(findings) == 2
+        assert set(rules_of(findings)) == {"blocking-under-lock"}
+
+    def test_thread_join_under_lock_is_flagged(self):
+        findings = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._worker = threading.Thread(target=self.run)
+
+                def run(self):
+                    pass
+
+                def stop(self):
+                    with self._lock:
+                        self._worker.join()
+            """, self.RULE)
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+    def test_str_join_under_lock_is_not_blocking(self):
+        findings = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def render(self, parts):
+                    with self._lock:
+                        return ",".join(parts)
+            """, self.RULE)
+        assert findings == []
+
+    def test_blocking_outside_the_critical_section_is_clean(self):
+        findings = run("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def wait(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(n)
+            """, self.RULE)
+        assert findings == []
+
+
+# ----------------------------------------------------------------- lock-order
+class TestLockOrder:
+    RULE = ["lock-order"]
+
+    def test_abba_inversion_is_flagged_at_both_inner_sites(self):
+        findings = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """, self.RULE)
+        assert rules_of(findings) == ["lock-order"] * 2
+        assert "ABBA" in findings[0].message
+
+    def test_inversion_across_modules_is_flagged(self):
+        findings = run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+            """, self.RULE, context_sources={
+                "deepspeed_tpu/other.py": textwrap.dedent("""
+                    from deepspeed_tpu.mod import A, B
+
+                    def g():
+                        with B:
+                            with A:
+                                pass
+                    """)})
+        # only the linted module's site is reported here; the message names
+        # the other module's inversion site
+        assert rules_of(findings) == ["lock-order"]
+        assert "deepspeed_tpu/other.py" in findings[0].message
+
+    def test_consistent_order_everywhere_is_clean(self):
+        findings = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def g(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """, self.RULE)
+        assert findings == []
+
+    def test_reacquiring_the_same_lock_object_is_not_an_inversion(self):
+        findings = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.RLock()
+
+                def f(self):
+                    with self._a:
+                        with self._a:
+                            pass
+            """, self.RULE)
+        assert findings == []
+
+
+# ------------------------------------------------- suppressions on these rules
+class TestThreadRuleSuppressions:
+    def test_reasoned_suppression_silences_a_thread_finding(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.text = ""
+                    self.n = 0
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.text)
+
+                def update(self):
+                    # dslint: disable-next-line=atomic-publish  # single owning writer
+                    self.n += 1
+            """, ["atomic-publish"])
+        assert findings == []
+
+    def test_reasonless_suppression_is_itself_a_finding(self):
+        findings = run("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.text = ""
+                    self.n = 0
+                    self._t = threading.Thread(target=self._reader)
+
+                def _reader(self):
+                    print(self.text)
+
+                def update(self):
+                    # dslint: disable-next-line=atomic-publish
+                    self.n += 1
+            """, ["atomic-publish"])
+        assert sorted(rules_of(findings)) == ["atomic-publish",
+                                              "bad-suppression"]
+
+
+# ----------------------------------------------- the real tree stays honest
+@pytest.mark.slow
+def test_real_tree_thread_model_sees_the_known_roots():
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[3]
+    files = iter_python_files([str(root / "deepspeed_tpu")])
+    modules, errors = load_modules(files, str(root))
+    assert not errors
+    tm = ThreadModel(modules)
+    labels = {(r.kind, r.key[1]) for r in tm.roots if r.key is not None}
+    assert ("thread", "AsyncCheckpointEngine._worker") in labels
+    assert ("signal", "Engine._on_preemption") in labels
+    assert any(k == "handler" and q.startswith("_OpsHandler.")
+               for k, q in labels)
