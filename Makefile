@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke ops-stress-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed lint-suppressions
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke ops-stress-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke qos-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed lint-suppressions
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -156,6 +156,15 @@ perf-smoke:
 # home replica, and zero requests are lost or orphaned
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --fleet-smoke
+
+# multi-tenant QoS (ISSUE 19): adversarial noisy-neighbor run — a batch-class
+# flood tenant against a tight token-rate quota while an interactive tenant
+# trickles, under 25% injected KV-allocator faults; interactive TTFT p95 must
+# stay within 2x its flood-free baseline, every flood shed must be the
+# structured retryable quota_exceeded/queue_full with a finite retry hint,
+# zero stalls, pool fully reclaimed, serving_tenant_* families strict-parse
+qos-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --qos-smoke
 
 # bench regression gate (ISSUE 16): bin/dstpu-benchdiff under the committed
 # benchtrack.json policy — the committed BENCH_r04->r05 pair must pass and an

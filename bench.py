@@ -1167,6 +1167,79 @@ def measure_serving_fleet(on_tpu: bool):
     return res
 
 
+def measure_serving_multitenant(on_tpu: bool):
+    """Multi-tenant QoS (ISSUE 19): the noisy-neighbor price tag.  A
+    batch-class flood tenant (tight token-rate quota) and an interactive
+    tenant share one QoS-armed engine; the timed pass reports aggregate
+    gated throughput and the interactive tenant's TTFT p95 UNDER the
+    flood — the SLO number the weighted-fair dequeue and the quota door
+    exist to protect (isolation correctness is CI-gated by
+    ``make qos-smoke``; here it is priced)."""
+    import jax
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import llama
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                                num_layers=8, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+        n_flood, flood_len, n_int, int_len, max_new = 12, 192, 6, 24, 24
+        num_blocks, block_size, maxb, budget, max_seqs = 2048, 32, 64, 512, 16
+        flood_rate, flood_burst = 1000.0, float(3 * flood_len)
+    else:
+        cfg = llama.LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, seq=256)
+        n_flood, flood_len, n_int, int_len, max_new = 8, 20, 4, 6, 8
+        num_blocks, block_size, maxb, budget, max_seqs = 64, 8, 8, 32, 8
+        flood_rate, flood_burst = 8.0, float(3 * flood_len)
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(
+        llama, cfg, params,
+        config={"dtype": "bfloat16" if on_tpu else "float32",
+                "serving_tracing": {"enabled": True},
+                "serving_qos": {"enabled": True,
+                                "tenants": {"flood": {
+                                    "tokens_per_s": flood_rate,
+                                    "token_burst": flood_burst}}}},
+        num_blocks=num_blocks, block_size=block_size, max_blocks_per_seq=maxb,
+        token_budget=budget, max_seqs_per_step=max_seqs)
+
+    rng = np.random.default_rng(0)
+    flood = [rng.integers(1, cfg.vocab_size, flood_len).tolist()
+             for _ in range(n_flood)]
+    trickle = [rng.integers(1, cfg.vocab_size, int_len).tolist()
+               for _ in range(n_int)]
+    prompts = flood + trickle
+    tenants = ["flood"] * n_flood + ["interactive"] * n_int
+    classes = ["batch"] * n_flood + ["interactive"] * n_int
+
+    # warm both prompt shapes and the live batch compositions outside the
+    # timed window (default tenant; its histograms are keyed separately)
+    eng.generate([list(p) for p in trickle], max_new_tokens=max_new, strict=False)
+    eng.generate([list(p) for p in trickle] + [list(f) for f in flood[:3]],
+                 max_new_tokens=max_new, strict=False)
+
+    t0 = time.perf_counter()
+    out = eng.generate(prompts, max_new_tokens=max_new, strict=False,
+                       tenants=tenants, service_classes=classes)
+    dt = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) - len(p) for r, p in zip(out, prompts)
+                 if r.ok and r.tokens)
+    hist = eng.tracer.tenant_histograms().get(("interactive", "ttft"))
+    pct = hist.percentiles() if hist is not None else None
+    quota_sheds = sum(n for (t, code), n in eng.qos.shed_by_tenant.items()
+                      if code == "quota_exceeded")
+    res = {"serving_multitenant_tok_s": round(tokens / max(dt, 1e-9), 1),
+           "serving_multitenant_requests": len(prompts),
+           "serving_multitenant_flood_quota_sheds": quota_sheds,
+           "serving_multitenant_interactive_ok":
+               sum(1 for r in out[n_flood:] if r.ok)}
+    if pct is not None:
+        res["serving_multitenant_interactive_ttft_p95_ms"] = round(
+            pct["p95"] * 1e3, 2)
+    return res
+
+
 def _ops_refresh_cost(eng, rounds: int = 20):
     """Median wall cost of one ops cache refresh on a live engine, plus the
     family count the endpoint would expose — the operator-facing price tag
@@ -1302,6 +1375,7 @@ def main():
         ("serving_mixed", 70, lambda: measure_serving_mixed(on_tpu)),
         ("shared_prefix", 45, lambda: measure_serving_shared_prefix(on_tpu)),
         ("serving_fleet", 60, lambda: measure_serving_fleet(on_tpu)),
+        ("serving_multitenant", 45, lambda: measure_serving_multitenant(on_tpu)),
         ("ring",    90,  lambda: measure_ring(on_tpu)),
         ("big",     55,  lambda: measure_training_big(on_tpu)),
         ("infinity", 0,  None),  # placeholder — budget set from remaining budget;

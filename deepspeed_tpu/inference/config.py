@@ -11,7 +11,7 @@ from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
                               ServingFaultToleranceConfig,
                               ServingFleetConfig,
                               ServingPerfConfig,
-                              ServingPrefixCacheConfig,
+                              ServingPrefixCacheConfig, ServingQosConfig,
                               ServingResilienceConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
@@ -81,6 +81,11 @@ class InferenceConfig(ConfigModel):
     # migration — inference/v2/router.py (section defined in
     # runtime/config.py so train+serve configs share one spelling)
     serving_fleet: ServingFleetConfig = Field(ServingFleetConfig)
+    # multi-tenant QoS: priority classes, per-tenant token-rate + KV-block
+    # quotas, weighted-fair dequeue, tenant-keyed prefix isolation —
+    # inference/v2/qos.py (section defined in runtime/config.py so
+    # train+serve configs share one spelling)
+    serving_qos: ServingQosConfig = Field(ServingQosConfig)
 
     def model_validate(self):
         if self.tensor_parallel is None:

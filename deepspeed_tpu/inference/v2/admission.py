@@ -59,6 +59,10 @@ class RequestResult:
     # carried through so a fleet router (or client) can back off for the
     # admission door's own pressure estimate instead of guessing
     retry_after_s: Optional[float] = None
+    # machine-readable shed code (ISSUE 19): routers must distinguish a
+    # per-tenant quota shed (rerouting to a sibling cannot help — the quota
+    # is tenant-global) from replica-local pressure without parsing `reason`
+    shed_code: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -118,6 +122,16 @@ class AdmissionTicket:
     # generated output, not prompt) instead of restarting from scratch.
     prefix: List[int] = dataclasses.field(default_factory=list)
     recovered: bool = False
+    # multi-tenant QoS identity (ISSUE 19): who this request belongs to and
+    # which service class it rides — carried end-to-end (ticket → sequence →
+    # journal → recovery) so policy decisions always see the same identity
+    tenant: str = "default"
+    service_class: str = "interactive"
+
+    @property
+    def token_cost(self) -> int:
+        """Full token history — the DRR/quota charging unit."""
+        return len(self.prompt) + len(self.prefix)
 
 
 @dataclasses.dataclass
@@ -138,6 +152,11 @@ class RecoveredRequest:
     # life had no TTL must not be handed one by the new engine's
     # default_ttl_s.  False (new requests): ttl_s=None falls through to the
     # config default exactly like generate().
+    # QoS identity (ISSUE 19): replayed from the journal admit record, so a
+    # restart can neither launder a best-effort request into interactive
+    # nor strip a tenant of its quota accounting
+    tenant: str = "default"
+    service_class: str = "interactive"
 
 
 class AdmissionQueue:
@@ -157,7 +176,8 @@ class AdmissionQueue:
     front door (ISSUE 6).
     """
 
-    def __init__(self, config=None, *, clock=time.monotonic, tracer=None):
+    def __init__(self, config=None, *, clock=time.monotonic, tracer=None,
+                 qos=None):
         from ...runtime.config import ServingResilienceConfig
         self.config = config if config is not None else ServingResilienceConfig()
         self.clock = clock
@@ -171,8 +191,17 @@ class AdmissionQueue:
         # Prometheus shed families next to the unlabeled shed_total
         self.shed_by_code: Dict[str, int] = {}
         self.last_retry_after: Dict[str, float] = {}
+        # multi-tenant QoS (ISSUE 19): with an enabled policy the single
+        # priority heap becomes per-service-class heaps drained by
+        # deficit-round-robin on token cost; quota sheds happen in submit.
+        # qos=None keeps every code path below byte-identical to PR-4.
+        self.qos = qos if (qos is not None and qos.enabled) else None
+        self._drr = self.qos.make_drr() if self.qos is not None else None
+        self._classes: Dict[str, List[Tuple[int, int, AdmissionTicket]]] = {}
 
     def __len__(self) -> int:
+        if self._drr is not None:
+            return sum(len(h) for h in self._classes.values())
         return len(self._heap)
 
     # ------------------------------------------------------------- shedding
@@ -187,7 +216,7 @@ class AdmissionQueue:
                               f"prompt of {prompt_len} tokens exceeds the per-sequence "
                               f"KV cap of {token_cap} tokens", retryable=False)
         depth_cap = self.config.max_queue_depth
-        if depth_cap and len(self._heap) >= depth_cap:
+        if depth_cap and len(self) >= depth_cap:
             # retry hint ~ time to drain a full queue: scale with the depth
             # cap (a deeper queue takes longer to clear), clamped to a
             # [0.05s, 2s] band so the hint is always a sane client backoff
@@ -210,8 +239,9 @@ class AdmissionQueue:
     def submit(self, uid: int, prompt: List[int], *, priority: int = 0,
                ttl_s: Optional[float] = None, kv_utilization: Optional[float] = None,
                token_cap: Optional[int] = None, prefix: Optional[List[int]] = None,
-               apply_default_ttl: bool = True,
-               recovered: bool = False) -> Optional[ShedReason]:
+               apply_default_ttl: bool = True, recovered: bool = False,
+               tenant: Optional[str] = None,
+               service_class: Optional[str] = None) -> Optional[ShedReason]:
         """Admit-or-shed.  Returns None on admission, else the ShedReason.
 
         ``prefix``/``recovered`` carry crash-recovery provenance (ISSUE 8):
@@ -220,17 +250,36 @@ class AdmissionQueue:
         cap is a genuine rejection, not an accounting accident.
         ``apply_default_ttl=False`` pins ``ttl_s`` as authoritative
         (None = deadline-free) so a re-admission never refreshes or invents
-        a deadline the original request didn't have."""
+        a deadline the original request didn't have.
+
+        ``tenant``/``service_class`` (ISSUE 19): with a QoS policy armed the
+        structural checks run first (an over-cap prompt is fatal no matter
+        whose it is), then the tenant's token-rate/KV quotas — a quota
+        violation is a retryable ``quota_exceeded`` shed whose
+        ``retry_after_s`` is the bucket's exact refill time.  Recovered
+        requests bypass quota enforcement: their cost was charged in the
+        life that admitted them, and recovery must not double-charge (or
+        shed) work the journal already accepted."""
         self.submitted_total += 1
         prefix = list(prefix) if prefix else []
+        tenant = str(tenant) if tenant else "default"
+        if self.qos is not None:
+            service_class = self.qos.service_class(service_class)
+        elif service_class is None:
+            service_class = "interactive"
         reason = self.shed_reason(len(prompt) + len(prefix),
                                   kv_utilization=kv_utilization,
                                   token_cap=token_cap)
+        if reason is None and self.qos is not None and not recovered:
+            reason = self.qos.admission_check(tenant, service_class,
+                                              len(prompt) + len(prefix))
         if reason is not None:
             self.shed_total += 1
             self.shed_by_code[reason.code] = self.shed_by_code.get(reason.code, 0) + 1
             if reason.retry_after_s is not None:
                 self.last_retry_after[reason.code] = reason.retry_after_s
+            if self.qos is not None:
+                self.qos.note_shed(tenant, reason.code, reason.retry_after_s)
             if self.tracer is not None:
                 if self.tracer.enabled:
                     # sheds never reach the ticket stamp below, so span
@@ -251,8 +300,14 @@ class AdmissionQueue:
         ticket = AdmissionTicket(uid=int(uid), prompt=list(prompt), priority=int(priority),
                                  deadline=(now + ttl) if ttl is not None else None,
                                  enqueue_t=now, prefix=prefix,
-                                 recovered=bool(recovered))
-        heapq.heappush(self._heap, (ticket.priority, self._seq, ticket))
+                                 recovered=bool(recovered),
+                                 tenant=tenant, service_class=service_class)
+        if self._drr is not None:
+            heapq.heappush(self._classes.setdefault(service_class, []),
+                           (ticket.priority, self._seq, ticket))
+            self.qos.note_admit(tenant, service_class, ticket.token_cost)
+        else:
+            heapq.heappush(self._heap, (ticket.priority, self._seq, ticket))
         self._seq += 1
         if self.tracer is not None:
             # the queue_wait span opens on the SAME clock value the ticket
@@ -261,7 +316,8 @@ class AdmissionQueue:
             self.tracer.event("submit", uid=ticket.uid, priority=ticket.priority)
             self.tracer.on_submit(ticket.uid, now,
                                   prompt_len=len(ticket.prompt) + len(ticket.prefix),
-                                  priority=ticket.priority)
+                                  priority=ticket.priority,
+                                  tenant=(tenant if self.qos is not None else None))
         return None
 
     # ---------------------------------------------------------------- drain
@@ -276,6 +332,8 @@ class AdmissionQueue:
         now = self.clock()
         if self.tracer is not None:
             self.tracer.tick(now)  # donate the already-read clock value
+        if self._drr is not None:
+            return self._pop_fair(now, expired), expired
         while self._heap:
             _, _, ticket = heapq.heappop(self._heap)
             if ticket.deadline is not None and now >= ticket.deadline:
@@ -284,17 +342,49 @@ class AdmissionQueue:
             return ticket, expired
         return None, expired
 
+    def _pop_fair(self, now: float,
+                  expired: List[AdmissionTicket]) -> Optional[AdmissionTicket]:
+        """Weighted-fair pop: sweep each class's expired heads (they never
+        reach the DRR — a dead ticket must not charge its class's deficit),
+        then let the DRR pick among the live heads by token cost."""
+        head_costs: Dict[str, int] = {}
+        for cls, heap in list(self._classes.items()):
+            while heap:
+                ticket = heap[0][2]
+                if ticket.deadline is not None and now >= ticket.deadline:
+                    expired.append(heapq.heappop(heap)[2])
+                    continue
+                head_costs[cls] = max(1, ticket.token_cost)
+                break
+            if not heap:
+                del self._classes[cls]
+        cls = self._drr.select(head_costs)
+        if cls is None:
+            return None
+        ticket = heapq.heappop(self._classes[cls])[2]
+        if not self._classes[cls]:
+            del self._classes[cls]
+        return ticket
+
+    def _entries(self) -> List[Tuple[int, int, AdmissionTicket]]:
+        if self._drr is not None:
+            return [e for heap in self._classes.values() for e in heap]
+        return self._heap
+
     def queued_stats(self) -> Tuple[int, int]:
         """(depth, longest queued prompt) without mutating the queue — the
         serve-time compile-cache prewarm sizes its candidate buckets from
         what is actually waiting to be admitted."""
-        if not self._heap:
+        entries = self._entries()
+        if not entries:
             return 0, 0
-        return len(self._heap), max(len(e[2].prompt) + len(e[2].prefix)
-                                    for e in self._heap)
+        return len(entries), max(len(e[2].prompt) + len(e[2].prefix)
+                                 for e in entries)
 
     def drain(self) -> List[AdmissionTicket]:
         """Remove and return every queued ticket (stall cleanup), in pop order."""
-        out = [entry[2] for entry in sorted(self._heap, key=lambda e: (e[0], e[1]))]
+        out = [entry[2] for entry in sorted(self._entries(),
+                                            key=lambda e: (e[0], e[1]))]
         self._heap = []
+        self._classes = {}
         return out

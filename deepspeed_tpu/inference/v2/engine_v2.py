@@ -41,6 +41,7 @@ from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchS
                        ServeCounters, materialize, round_up_pow2)
 from .journal import RequestJournal, journal_bytes
 from .kv_metrics import KVObservability
+from .qos import QosPolicy
 from .ragged_manager import PrefixCache, RaggedStateManager
 from .scheduler import SplitFuseScheduler
 
@@ -146,8 +147,16 @@ class InferenceEngineV2:
         # the host already touches, so tracing adds zero device syncs
         self.tracer = RequestTracer(self.config.serving_tracing,
                                     clock=self._clock, telemetry=telemetry)
+        # multi-tenant QoS (ISSUE 19): per-tenant quotas + weighted-fair
+        # dequeue + victim steering.  Constructed only when the section is
+        # armed — self.qos is None otherwise and every downstream seam
+        # (admission, scheduler, metrics) keeps its pre-QoS behavior
+        self.qos = None
+        if self.config.serving_qos.enabled:
+            self.qos = QosPolicy(self.config.serving_qos, clock=self._clock)
+            self.qos.kv_blocks_of = self.manager.tenant_blocks
         self.admission = AdmissionQueue(self.resilience, clock=self._clock,
-                                        tracer=self.tracer)
+                                        tracer=self.tracer, qos=self.qos)
         self._deadline_expired_total = 0
         self._stall_streak = 0
         self.stalls_total = 0  # lifetime watchdog trips (streaks are transient)
@@ -157,6 +166,7 @@ class InferenceEngineV2:
                                             resilience=self.resilience,
                                             tracer=self.tracer,
                                             gauge_timestamp=self._gauge_timestamp)
+        self.scheduler.qos = self.qos
         # serving fault tolerance (ISSUE 8): durable request journal + serve-
         # iteration liveness heartbeat.  Both arm from config OR the
         # ServingSupervisor's env exports (DSTPU_SERVING_JOURNAL +
@@ -306,14 +316,26 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------ intake
     def put(self, uids: Sequence[int], prompts: Sequence[Sequence[int]],
-            ttl_s: Optional[float] = None) -> None:
+            ttl_s: Optional[float] = None, *, tenant: Optional[str] = None,
+            service_class: Optional[str] = None) -> None:
         """Enqueue requests directly into the state manager (reference
         engine_v2.put:107), bypassing the admission queue — the step()-level
         API for callers running their own loop.  ``ttl_s`` stamps a deadline
         that step() enforces between forwards: an expired sequence is evicted
         (done, ``finish_reason: deadline_expired``, blocks reclaimed) before
-        the next ragged batch is scheduled."""
+        the next ragged batch is scheduled.
+
+        ``tenant``/``service_class`` (ISSUE 19) stamp QoS identity on the
+        whole batch: the prefix cache keys on the tenant and the per-tenant
+        gauges attribute the load.  put() bypasses the admission queue, so
+        quota SHEDDING does not apply here — callers running their own loop
+        own their own backpressure — but identity and accounting do."""
         ttl = ttl_s if ttl_s is not None else self.resilience.default_ttl_s
+        tenant = str(tenant) if tenant else "default"
+        if self.qos is not None:
+            service_class = self.qos.service_class(service_class)
+        elif service_class is None:
+            service_class = "interactive"
         now = None
         if ttl is not None or self.tracer.enabled:
             # one clock read covers the whole batch: the deadline stamp, the
@@ -324,17 +346,24 @@ class InferenceEngineV2:
         self._reset_table_width_if_idle()
         for uid, prompt in zip(uids, prompts):
             seq = self.manager.add_sequence(int(uid), [int(t) for t in prompt],
-                                            deadline=deadline)
+                                            deadline=deadline, tenant=tenant,
+                                            service_class=service_class)
             self._map_prefix(seq)
+            if self.qos is not None:
+                self.qos.note_admit(tenant, service_class, len(prompt))
             if self.journal is not None:
                 # step()-level requests journal too (max_new_tokens=0: the
                 # caller's own loop owns the budget) so a crash loses neither
                 # path's requests; recovery re-admission targets the
                 # generate()/serve_recovered contract
                 self.journal.record_admit(int(uid), [int(t) for t in prompt],
-                                          ttl_s=ttl, max_new_tokens=0)
+                                          ttl_s=ttl, max_new_tokens=0,
+                                          tenant=tenant,
+                                          service_class=service_class)
             self.tracer.event("admit", uid=int(uid), direct=True)
-            self.tracer.on_admit(int(uid), now, prompt_len=len(prompt))
+            self.tracer.on_admit(int(uid), now, prompt_len=len(prompt),
+                                 tenant=(tenant if self.qos is not None
+                                         else None))
         # prefix-sharing opportunity over the post-intake live set (the put()
         # analog of _serve's per-pass observation; the new sequences are
         # already live, so no extras needed)
@@ -1105,7 +1134,9 @@ class InferenceEngineV2:
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, greedy: bool = True, *,
                  strict: bool = True, priorities: Optional[Sequence[int]] = None,
-                 ttl_s: Optional[float] = None
+                 ttl_s: Optional[float] = None,
+                 tenants: Optional[Sequence[str]] = None,
+                 service_classes: Optional[Sequence[str]] = None
                  ) -> Union[List[List[int]], List[RequestResult]]:
         """Serve a batch to completion through the continuous-batching loop.
 
@@ -1129,7 +1160,8 @@ class InferenceEngineV2:
         uids = list(range(len(prompts)))
         results = self._serve(uids, prompts, max_new_tokens=max_new_tokens,
                               eos_token_id=eos_token_id, greedy=greedy, strict=strict,
-                              priorities=priorities, ttl_s=ttl_s)
+                              priorities=priorities, ttl_s=ttl_s,
+                              tenants=tenants, service_classes=service_classes)
         if strict:
             return [results[u].tokens for u in uids]
         return [results[u] for u in uids]
@@ -1153,6 +1185,11 @@ class InferenceEngineV2:
                     for r in requests if r.prefix}
         ttls = {int(r.uid): r.ttl_s for r in requests if r.pin_ttl}
         priorities = [int(r.priority) for r in requests]
+        # QoS identity rides recovery AS JOURNALED (ISSUE 19): the planner
+        # copied tenant/class from the journal entry, so a crash can never
+        # launder a best-effort request into interactive
+        tenants = [r.tenant for r in requests]
+        service_classes = [r.service_class for r in requests]
         self.ft_stats["recovered_requests_total"] += len(prefixes)
         for r in requests:
             if r.prefix:
@@ -1163,14 +1200,17 @@ class InferenceEngineV2:
         return self._serve(uids, prompts, max_new_tokens=max_new_tokens,
                            eos_token_id=eos_token_id, greedy=greedy,
                            strict=strict, priorities=priorities, ttl_s=None,
-                           prefixes=prefixes, ttls=ttls)
+                           prefixes=prefixes, ttls=ttls, tenants=tenants,
+                           service_classes=service_classes)
 
     def _serve(self, uids: List[int], prompts: Sequence[Sequence[int]], *,
                max_new_tokens: int, eos_token_id: Optional[int], greedy: bool,
                strict: bool, priorities: Optional[Sequence[int]],
                ttl_s: Optional[float],
                prefixes: Optional[Dict[int, List[int]]] = None,
-               ttls: Optional[Dict[int, Optional[float]]] = None
+               ttls: Optional[Dict[int, Optional[float]]] = None,
+               tenants: Optional[Sequence[str]] = None,
+               service_classes: Optional[Sequence[str]] = None
                ) -> Dict[int, RequestResult]:
         my = set(uids)
         self._reset_table_width_if_idle()
@@ -1200,13 +1240,22 @@ class InferenceEngineV2:
                     t, apply_default = ttls[uid], False  # recovery pins the TTL
                 else:
                     t, apply_default = ttl_s, True
+                tenant = tenants[i] if tenants is not None else None
+                service_class = service_classes[i] if service_classes is not None else None
+                if self.qos is not None:
+                    # normalize HERE (not just inside submit) so the journal
+                    # admit record carries the class the policy resolved —
+                    # replay must reconstruct identity, not re-default it
+                    tenant = str(tenant) if tenant else "default"
+                    service_class = self.qos.service_class(service_class)
                 shed = self.admission.submit(
                     uid, [int(tok) for tok in prompt],
                     priority=priorities[i] if priorities is not None else 0,
                     ttl_s=t, apply_default_ttl=apply_default,
                     kv_utilization=self.manager.kv_utilization(),
                     token_cap=token_cap, prefix=prefix or None,
-                    recovered=bool(prefix))
+                    recovered=bool(prefix), tenant=tenant,
+                    service_class=service_class)
                 if shed is not None:
                     self._record_resilience("serving_shed", uid=uid, code=shed.code,
                                             retryable=shed.retryable, detail=shed.detail)
@@ -1217,13 +1266,19 @@ class InferenceEngineV2:
                         # a PREVIOUS generation's watched set) — but its
                         # terminal must still be durable, or replay re-serves
                         # it forever / reports it unresolved
-                        self.journal.record_terminal(uid, SHED, reason=str(shed),
-                                                     retryable=shed.retryable)
+                        self.journal.record_terminal(
+                            uid, SHED, reason=str(shed),
+                            retryable=shed.retryable,
+                            # gate on qos: a QoS-off journal stays byte-
+                            # identical to the pre-QoS record format
+                            shed_code=(shed.code if self.qos is not None
+                                       else None))
                     if strict:
                         raise RuntimeError(f"request {uid} shed: {shed}")
                     results[uid] = RequestResult(uid=uid, status=SHED, reason=str(shed),
                                                  retryable=shed.retryable,
-                                                 retry_after_s=shed.retry_after_s)
+                                                 retry_after_s=shed.retry_after_s,
+                                                 shed_code=shed.code)
                 elif self.journal is not None:
                     # the effective TTL (what admission just stamped) rides
                     # the admit record, with a wall-clock stamp so recovery
@@ -1235,7 +1290,10 @@ class InferenceEngineV2:
                         priority=priorities[i] if priorities is not None else 0,
                         ttl_s=effective, max_new_tokens=max_new_tokens,
                         eos_token_id=eos_token_id, greedy=greedy,
-                        prefix_len=len(prefix))
+                        prefix_len=len(prefix),
+                        tenant=(tenant if tenant is not None else "default"),
+                        service_class=(service_class if service_class is not None
+                                       else "interactive"))
             # counterfactual prefix-cache report for THIS pass: the queued
             # (non-shed) prompts joining whatever is already live
             self._observe_prefix({uid: [int(t) for t in prompt]
@@ -1702,7 +1760,9 @@ class InferenceEngineV2:
             seq = self.manager.add_sequence(ticket.uid, ticket.prompt + ticket.prefix,
                                             priority=ticket.priority,
                                             deadline=ticket.deadline, queue_wait_s=wait,
-                                            prompt_len=len(ticket.prompt))
+                                            prompt_len=len(ticket.prompt),
+                                            tenant=ticket.tenant,
+                                            service_class=ticket.service_class)
             # admit-time prefix lookup (ISSUE 13): map whatever shared prompt
             # blocks are already computed — a journal-replayed request lands
             # back on the shared blocks its previous life rode — and the
@@ -1711,7 +1771,9 @@ class InferenceEngineV2:
             self.tracer.event("admit", step=self.scheduler.steps, uid=ticket.uid,
                               **({"recovered": True} if ticket.recovered else {}))
             self.tracer.on_admit(ticket.uid, now, queue_wait_s=wait,
-                                 prompt_len=len(ticket.prompt) + len(ticket.prefix))
+                                 prompt_len=len(ticket.prompt) + len(ticket.prefix),
+                                 tenant=(ticket.tenant if self.qos is not None
+                                         else None))
         return False
 
     def _handle_stall(self, my: set, results: Dict[int, RequestResult],
@@ -1910,4 +1972,10 @@ class InferenceEngineV2:
             "perf": self._perf_snapshot(),
             # the recent engine-event history (always on, bounded ring)
             "flight_recorder": self.tracer.recorder.tail(32),
+            # multi-tenant QoS (ISSUE 19): per-tenant admit/shed/token
+            # counters, resident KV blocks, and the last quota retry hint —
+            # {"enabled": False} when the policy layer is off so probes can
+            # key on one shape
+            "qos": (self.qos.snapshot() if self.qos is not None
+                    else {"enabled": False}),
         }

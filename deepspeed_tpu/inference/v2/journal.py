@@ -114,6 +114,13 @@ class JournalEntry:
     prefix_len: int = 0                    # provenance of the latest admit
     admits: int = 0                        # admit records seen (1 + recoveries)
     terminal: Optional[Dict[str, Any]] = None
+    # QoS identity (ISSUE 19): journaled at admit so recovery re-admits a
+    # request under its ORIGINAL tenant and service class — a restart can
+    # never launder best-effort traffic into interactive or detach a
+    # request from its tenant's quota accounting.  Defaults match the
+    # pre-QoS engine, so journals written before this field replay cleanly.
+    tenant: str = "default"
+    service_class: str = "interactive"
 
     @property
     def done(self) -> bool:
@@ -260,7 +267,9 @@ class RequestJournal:
                      ttl_s: Optional[float] = None, max_new_tokens: int = 0,
                      eos_token_id: Optional[int] = None, greedy: bool = True,
                      prefix_len: int = 0,
-                     admit_wall: Optional[float] = None) -> None:
+                     admit_wall: Optional[float] = None,
+                     tenant: str = "default",
+                     service_class: str = "interactive") -> None:
         uid = int(uid)
         self.watched.add(uid)
         # ``admit_wall`` transplants an entry between journals (fleet failover
@@ -270,12 +279,18 @@ class RequestJournal:
         # their own wall.
         wall = self._wall() if admit_wall is None else float(admit_wall)
         # strict mode fsyncs admits eagerly: losing one loses the request
-        self._emit({"t": "admit", "uid": uid, "prompt": [int(t) for t in prompt],
-                    "priority": int(priority), "ttl_s": ttl_s,
-                    "wall": wall, "max_new_tokens": int(max_new_tokens),
-                    "eos": eos_token_id, "greedy": bool(greedy),
-                    "key": [self.seed, uid], "prefix_len": int(prefix_len)},
-                   durable=True)
+        rec = {"t": "admit", "uid": uid, "prompt": [int(t) for t in prompt],
+               "priority": int(priority), "ttl_s": ttl_s,
+               "wall": wall, "max_new_tokens": int(max_new_tokens),
+               "eos": eos_token_id, "greedy": bool(greedy),
+               "key": [self.seed, uid], "prefix_len": int(prefix_len)}
+        # QoS identity rides the admit record only when it differs from the
+        # defaults — a QoS-off engine's journal stays byte-identical to PR-8
+        if tenant and tenant != "default":
+            rec["tenant"] = str(tenant)
+        if service_class and service_class != "interactive":
+            rec["cls"] = str(service_class)
+        self._emit(rec, durable=True)
 
     def note_tokens(self, uid: int, tokens) -> None:
         """Buffer emitted tokens (one int or a list) — no IO until flush().
@@ -322,7 +337,8 @@ class RequestJournal:
     def record_terminal(self, uid: int, status: str, *,
                         finish_reason: Optional[str] = None,
                         reason: Optional[str] = None, retryable: bool = False,
-                        n_tokens: int = 0) -> None:
+                        n_tokens: int = 0,
+                        shed_code: Optional[str] = None) -> None:
         """No uid filtering here — the ENGINE's hooks filter on ``watched``;
         the supervisor writes terminals directly (drain-mode sheds,
         budget-exhaustion finalization) for uids it owns by contract.
@@ -340,6 +356,13 @@ class RequestJournal:
         end = {"t": "end", "uid": int(uid), "status": str(status),
                "finish_reason": finish_reason, "reason": reason,
                "retryable": bool(retryable), "n_tokens": int(n_tokens)}
+        if shed_code is not None:
+            # machine-readable shed code (ISSUE 19), written only when the
+            # caller has one: a quota shed adopted from this journal after a
+            # crash must still read as quota_exceeded to the fleet router
+            # (reroute-to-sibling cannot help) — and records without codes
+            # stay byte-identical to the pre-QoS format
+            end["code"] = str(shed_code)
         if self.strict:
             self._write_records(([tok] if tok else []) + [end], fsync=True)
         else:
@@ -427,6 +450,11 @@ def replay_journal(path: str, *, truncate: bool = True) -> JournalState:
             key = rec.get("key") or [0, uid]
             entry.sampling_key = (int(key[0]), int(key[1]))
             entry.prefix_len = prefix_len
+            # QoS identity (ISSUE 19): absent keys fold to the pre-QoS
+            # defaults, so old journals — and QoS-off journals, which omit
+            # default values — replay unchanged
+            entry.tenant = str(rec.get("tenant", "default"))
+            entry.service_class = str(rec.get("cls", "interactive"))
             entry.admits += 1
             # a re-admission reopens a request a previous generation may have
             # finalized (results adopted then re-served is a logic error the
@@ -451,7 +479,8 @@ def replay_journal(path: str, *, truncate: bool = True) -> JournalState:
                               "finish_reason": rec.get("finish_reason"),
                               "reason": rec.get("reason"),
                               "retryable": bool(rec.get("retryable", False)),
-                              "n_tokens": int(rec.get("n_tokens", 0))}
+                              "n_tokens": int(rec.get("n_tokens", 0)),
+                              "code": rec.get("code")}
         else:
             logger.warning(f"request journal {path}: unknown record type "
                            f"{kind!r} skipped (version skew?)")

@@ -367,11 +367,22 @@ class BlockCensus:
     def _check_shared_content(self, seqs: Dict[int, Any]) -> None:
         """Every mapper of a shared block must hold the SAME token ids for
         the block's position range — the no-request-observes-another's-KV
-        invariant the prefix tree's token verification exists to uphold."""
+        invariant the prefix tree's token verification exists to uphold —
+        AND belong to the same tenant: the tenant-seeded hash chain makes
+        cross-tenant sharing impossible by keying, and this audit proves it
+        stayed impossible through CoW maps, rollbacks and reclaims."""
         bs = self.block_size
         for b, rec in self.blocks.items():
             if len(rec.owners) < 2:
                 continue
+            tenants = {getattr(seqs[uid], "tenant", "default")
+                       for uid in rec.owners if uid in seqs}
+            if len(tenants) > 1:
+                raise CensusInvariantError(
+                    f"block {b} is shared ACROSS tenants {sorted(tenants)} — "
+                    f"the per-tenant hash namespace was bypassed; one "
+                    f"tenant can time another's cache", block=b,
+                    uid=rec.owners[0])
             reference: Optional[List[int]] = None
             ref_uid: Optional[int] = None
             for uid in rec.owners:
@@ -400,15 +411,31 @@ class BlockCensus:
 # Prefix-sharing opportunity analysis
 # ==========================================================================
 
-def block_hashes(tokens: List[int], block_size: int) -> List[bytes]:
+def tenant_namespace(tenant: Optional[str]) -> bytes:
+    """Hash-chain seed for a tenant's prefix keying.  The default tenant
+    keeps the legacy empty seed (single-tenant hashes — and therefore
+    sharing, affinity homing and journal replay — are byte-identical with
+    QoS on or off); any other tenant seeds the chain with its id, so two
+    tenants' byte-identical prompts hash to DISJOINT chains and can never
+    share a block (the cross-tenant cache-timing side-channel is closed
+    structurally, not by a lookup-time filter)."""
+    if not tenant or tenant == "default":
+        return b""
+    return b"tenant:" + tenant.encode("utf-8", "surrogatepass")
+
+
+def block_hashes(tokens: List[int], block_size: int,
+                 namespace: bytes = b"") -> List[bytes]:
     """Chained token-block hashes over the FULL blocks of ``tokens`` — the
     exact keying a copy-on-write prefix tree will use: block ``i``'s hash
     covers its own tokens AND its ancestry (hash chaining), so two sequences
     share hash ``i`` iff their first ``(i+1) * block_size`` tokens are
     identical.  Partial trailing blocks are excluded (they can never be
-    shared read-only)."""
+    shared read-only).  ``namespace`` seeds the chain root (see
+    :func:`tenant_namespace`); the default empty seed preserves the legacy
+    keying."""
     out: List[bytes] = []
-    parent = b""
+    parent = namespace
     for i in range(len(tokens) // block_size):
         chunk = tokens[i * block_size:(i + 1) * block_size]
         h = hashlib.blake2b(digest_size=16)

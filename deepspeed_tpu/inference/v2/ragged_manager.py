@@ -34,7 +34,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .blocked_allocator import BlockedAllocator, KVAllocationError
-from .kv_metrics import block_hashes
+from .kv_metrics import block_hashes, tenant_namespace
 
 # finish reasons that mark an EVICTION (the request did not run to a useful
 # completion); retire() excludes them from completed_requests even when the
@@ -87,6 +87,13 @@ class SequenceDescriptor:
     prefix_registered: int = 0
     # prefill tokens this sequence skipped by mapping shared blocks
     prefix_cached_tokens: int = 0
+    # --- multi-tenant QoS identity (ISSUE 19) ---
+    # owner tenant + service class, carried from the admission ticket: the
+    # prefix-cache keying folds the tenant in (cross-tenant sharing is
+    # impossible) and KV-pressure preemption prefers over-quota /
+    # lower-class victims
+    tenant: str = "default"
+    service_class: str = "interactive"
 
     @property
     def pending_tokens(self) -> int:
@@ -259,7 +266,9 @@ class RaggedStateManager:
     def add_sequence(self, uid: int, prompt_tokens: List[int], *, priority: int = 0,
                      deadline: Optional[float] = None,
                      queue_wait_s: float = 0.0,
-                     prompt_len: Optional[int] = None) -> SequenceDescriptor:
+                     prompt_len: Optional[int] = None,
+                     tenant: str = "default",
+                     service_class: str = "interactive") -> SequenceDescriptor:
         """``prompt_len`` pins where prompt ends and generated output begins
         when it differs from ``len(prompt_tokens)`` — crash recovery re-admits
         ``prompt + already-emitted-prefix`` as the token history (the prefill
@@ -277,13 +286,20 @@ class RaggedStateManager:
         seq = SequenceDescriptor(uid=uid, tokens=list(prompt_tokens),
                                  prompt_len=int(prompt_len), arrival=self._arrivals,
                                  priority=priority, deadline=deadline,
-                                 queue_wait_s=queue_wait_s)
+                                 queue_wait_s=queue_wait_s,
+                                 tenant=str(tenant) if tenant else "default",
+                                 service_class=service_class)
         if self.prefix_cache is not None:
             # the tree's keying, computed once per life: chained hashes over
             # the PROMPT portion only (a recovered request's replayed prefix
-            # is generated output — never shareable read-only)
+            # is generated output — never shareable read-only).  The chain
+            # is seeded with the tenant namespace (ISSUE 19): cross-tenant
+            # prompts hash to disjoint chains, so the cache STRUCTURALLY
+            # cannot share a block across tenants; the default tenant keeps
+            # the legacy empty seed (single-tenant keying unchanged)
             seq.prefix_hashes = block_hashes(seq.tokens[:seq.prompt_len],
-                                             self.block_size)
+                                             self.block_size,
+                                             tenant_namespace(seq.tenant))
         self._arrivals += 1
         self.seqs[uid] = seq
         self.total_requests += 1
@@ -353,7 +369,8 @@ class RaggedStateManager:
                 break  # private progress past the boundary, or past the prompt
             if seq.prefix_hashes[i] not in cache.entries:
                 break  # miss — probe before building the token tuple
-            parent = seq.prefix_hashes[i - 1] if i else b""
+            parent = (seq.prefix_hashes[i - 1] if i
+                      else tenant_namespace(seq.tenant))
             block = cache.lookup(seq.prefix_hashes[i], parent,
                                  tuple(seq.tokens[i * bs:(i + 1) * bs]))
             if block is None:
@@ -424,7 +441,8 @@ class RaggedStateManager:
         while seq.prefix_registered < n_complete:
             i = seq.prefix_registered
             cache.register(seq.prefix_hashes[i],
-                           seq.prefix_hashes[i - 1] if i else b"",
+                           (seq.prefix_hashes[i - 1] if i
+                            else tenant_namespace(seq.tenant)),
                            seq.blocks[i],
                            tuple(seq.tokens[i * bs:(i + 1) * bs]))
             cache.misses_total += 1
@@ -557,3 +575,21 @@ class RaggedStateManager:
         excluded) — the paged-attention memory-pressure gauge."""
         usable = self.allocator.num_blocks - 1
         return (usable - self.allocator.free_blocks) / max(usable, 1)
+
+    def tenant_blocks(self, tenant: str) -> int:
+        """Resident KV blocks mapped by ``tenant``'s live sequences — the
+        QoS layer's KV-quota denominator.  Shared (prefix) blocks count
+        once per mapper: a tenant pays for every mapping it holds, which
+        is exactly what its eviction would release pressure on.  List copy
+        first (GIL-atomic) for the same concurrent-mutation reason as
+        :meth:`live_uids`."""
+        return sum(len(s.blocks) for s in list(self.seqs.values())
+                   if not s.done and s.tenant == tenant)
+
+    def tenant_block_usage(self) -> Dict[str, int]:
+        """{tenant: resident blocks} over live sequences (gauge export)."""
+        out: Dict[str, int] = {}
+        for s in list(self.seqs.values()):
+            if not s.done and s.blocks:
+                out[s.tenant] = out.get(s.tenant, 0) + len(s.blocks)
+        return out

@@ -64,7 +64,8 @@ from ...runtime.config import (OpsServerConfig, ServingFaultToleranceConfig,
 from ...utils.logging import logger
 from .admission import FAILED, SHED, RequestResult
 from .journal import RequestJournal, replay_journal
-from .kv_metrics import block_hashes
+from .kv_metrics import block_hashes, tenant_namespace
+from .qos import QUOTA_EXCEEDED
 from .supervisor import ServeSpec, ServingSupervisor, result_from_entry
 
 UNROUTABLE_REASON = ("fleet: every replica is drained (all restart budgets "
@@ -159,6 +160,11 @@ class FleetRouter:
         self.migrated_requests_total = 0     # entries transplanted
         self.adopted_from_journal_total = 0  # dead-journal terminals adopted
         self.lost_total = 0                  # the zero-lost-requests invariant
+        # per-tenant fleet counters (ISSUE 19): placement and quota sheds by
+        # tenant — a quota shed is tenant-global (rerouting to a sibling
+        # cannot help), so it surfaces here instead of in reroutes_total
+        self.routed_by_tenant: Dict[str, int] = {}
+        self.quota_sheds_by_tenant: Dict[str, int] = {}
         self.recorder = FlightRecorder(256)
         self._served_uids: Set[int] = set()
         # ---- merged fleet ops surface: aggregator always on (host dicts are
@@ -230,21 +236,27 @@ class FleetRouter:
         return [r.index for r in self.replicas if self._is_healthy(r.index, now)]
 
     # --------------------------------------------------------------- routing
-    def _affinity_home(self, prompt: Sequence[int]) -> Optional[int]:
+    def _affinity_home(self, prompt: Sequence[int],
+                       tenant: str = "default") -> Optional[int]:
         """Home replica for a prompt header: the chained block hash at depth
         ``affinity_blocks`` (the SAME key the prefix cache indexes by, so
-        prompts that would share cached blocks share a home).  None when the
-        prompt has no full block or affinity is off."""
+        prompts that would share cached blocks share a home).  The tenant
+        namespace seeds the chain exactly as the cache's own key does
+        (ISSUE 19) — two tenants with byte-identical prompts get independent
+        homes, so placement leaks nothing across the tenant boundary either.
+        None when the prompt has no full block or affinity is off."""
         if self.cfg.affinity_blocks <= 0:
             return None
         depth = self.cfg.affinity_blocks * self.block_size
-        hashes = block_hashes(list(prompt)[:depth], self.block_size)
+        hashes = block_hashes(list(prompt)[:depth], self.block_size,
+                              tenant_namespace(tenant))
         if not hashes:
             return None
         return int.from_bytes(hashes[-1][:8], "big") % len(self.replicas)
 
     def route(self, prompt: Sequence[int], *,
-              exclude: Iterable[int] = ()) -> Optional[int]:
+              exclude: Iterable[int] = (),
+              tenant: str = "default") -> Optional[int]:
         """Pick a replica for one prompt: the healthy affinity home when it
         has one, else the least-loaded healthy replica; when NO replica is
         healthy, any undrained one (best-effort beats refusal — staleness
@@ -257,7 +269,7 @@ class FleetRouter:
         if not candidates:
             return None
         healthy = [i for i in candidates if self._is_healthy(i, now)]
-        home = self._affinity_home(prompt)
+        home = self._affinity_home(prompt, tenant)
         if home is not None and home in healthy \
                 and self._load_score(home) < EXHAUSTION_PENALTY:
             self.affinity_routed_total += 1
@@ -273,7 +285,9 @@ class FleetRouter:
               max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
               greedy: bool = True,
               priorities: Optional[Sequence[int]] = None,
-              ttl_s: Optional[Sequence[Optional[float]]] = None
+              ttl_s: Optional[Sequence[Optional[float]]] = None,
+              tenants: Optional[Sequence[str]] = None,
+              service_classes: Optional[Sequence[str]] = None
               ) -> List[RequestResult]:
         """Serve a workload across the fleet; one terminal result per prompt,
         in input order.  Every request reaches exactly one terminal — sheds
@@ -296,7 +310,13 @@ class FleetRouter:
         self._served_uids.update(uid_list)
         specs = [ServeSpec(uid=uid, prompt=list(prompt),
                            priority=(int(priorities[i]) if priorities else 0),
-                           ttl_s=(ttl_s[i] if ttl_s else None))
+                           ttl_s=(ttl_s[i] if ttl_s else None),
+                           tenant=(str(tenants[i]) if tenants is not None
+                                   and tenants[i] else "default"),
+                           service_class=(str(service_classes[i])
+                                          if service_classes is not None
+                                          and service_classes[i]
+                                          else "interactive"))
                  for i, (uid, prompt) in enumerate(zip(uid_list, prompts))]
         spec_by_uid = {s.uid: s for s in specs}
         results: Dict[int, RequestResult] = {}
@@ -305,12 +325,14 @@ class FleetRouter:
         shed_at: Dict[int, Set[int]] = {}
         assignment: Dict[int, List[ServeSpec]] = {}
         for spec in specs:
-            target = self.route(spec.prompt)
+            target = self.route(spec.prompt, tenant=spec.tenant)
             if target is None:
                 results[spec.uid] = self._lost(spec.uid)
                 continue
             assignment.setdefault(target, []).append(spec)
             self.routed_total[target] += 1
+            self.routed_by_tenant[spec.tenant] = \
+                self.routed_by_tenant.get(spec.tenant, 0) + 1
             self._event("route", uid=spec.uid, replica=target)
 
         attempt = 0
@@ -349,11 +371,33 @@ class FleetRouter:
                     spec = spec_by_uid.get(uid)
                     if spec is None:
                         continue
+                    if result.status == SHED \
+                            and result.shed_code == QUOTA_EXCEEDED:
+                        # a quota shed is TENANT-global, not replica-local:
+                        # every sibling enforces the same per-tenant budget,
+                        # so rerouting would just burn its admission door
+                        # (and journal a second shed terminal that recovery
+                        # would adopt).  Surface it to the caller with the
+                        # quota-derived retry_after_s — the client backs off
+                        # for the tenant's own refill window
+                        self.quota_sheds_by_tenant[spec.tenant] = \
+                            self.quota_sheds_by_tenant.get(spec.tenant, 0) + 1
+                        if result.retry_after_s is not None:
+                            # the quota window still floors THIS round's
+                            # backoff: reroutes sharing the round must not
+                            # land before the tenant's bucket can refill
+                            retry_hints.append(float(result.retry_after_s))
+                        self._event("quota_shed", uid=uid, replica=index,
+                                    tenant=spec.tenant,
+                                    retry_after_s=result.retry_after_s)
+                        results[uid] = result
+                        continue
                     if result.status == SHED and result.retryable \
                             and attempt < self.cfg.max_reroutes:
                         shed_at.setdefault(uid, set()).add(index)
                         target = self.route(spec.prompt,
-                                            exclude=shed_at[uid])
+                                            exclude=shed_at[uid],
+                                            tenant=spec.tenant)
                         if target is not None:
                             next_assignment.setdefault(target, []).append(spec)
                             self.routed_total[target] += 1
@@ -419,7 +463,8 @@ class FleetRouter:
                 adopted[spec.uid] = result_from_entry(entry)
                 self.adopted_from_journal_total += 1
                 continue
-            target = self.route(spec.prompt, exclude={dead_index})
+            target = self.route(spec.prompt, exclude={dead_index},
+                                tenant=spec.tenant)
             if target is None:
                 lost[spec.uid] = self._lost(spec.uid)
                 continue
@@ -429,11 +474,14 @@ class FleetRouter:
                     self.replicas[target].journal_path, fsync_every=1,
                     wall_clock=self._wall)
             if entry is not None:
+                # identity migrates AS JOURNALED: the target's recovery reads
+                # tenant/class from this record, never from the spec
                 journal.record_admit(
                     spec.uid, entry.prompt, priority=entry.priority,
                     ttl_s=entry.ttl_s, max_new_tokens=entry.max_new_tokens,
                     eos_token_id=entry.eos_token_id, greedy=entry.greedy,
-                    admit_wall=entry.admit_wall)
+                    admit_wall=entry.admit_wall, tenant=entry.tenant,
+                    service_class=entry.service_class)
                 if entry.emitted:
                     journal.note_tokens(spec.uid, list(entry.emitted))
             # entry None = the replica died before durably admitting it:

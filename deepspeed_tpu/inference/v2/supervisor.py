@@ -74,6 +74,10 @@ class ServeSpec:
     prompt: List[int]
     priority: int = 0
     ttl_s: Optional[float] = None
+    # QoS identity (ISSUE 19): the caller's tenant + service class, carried
+    # through admission, the journal and recovery unchanged
+    tenant: str = "default"
+    service_class: str = "interactive"
 
 
 @dataclasses.dataclass
@@ -96,7 +100,8 @@ def result_from_entry(entry: JournalEntry) -> RequestResult:
     return RequestResult(uid=entry.uid, status=status, tokens=tokens,
                          finish_reason=term.get("finish_reason"),
                          reason=term.get("reason"),
-                         retryable=bool(term.get("retryable", False)))
+                         retryable=bool(term.get("retryable", False)),
+                         shed_code=term.get("code"))
 
 
 def plan_recovery(state: JournalState, specs: Sequence[ServeSpec], *,
@@ -131,7 +136,8 @@ def plan_recovery(state: JournalState, specs: Sequence[ServeSpec], *,
                 plan.entries.append(RecoveredRequest(
                     uid=uid, prompt=list(spec.prompt), prefix=[],
                     priority=spec.priority, ttl_s=spec.ttl_s,
-                    pin_ttl=spec.ttl_s is not None))
+                    pin_ttl=spec.ttl_s is not None,
+                    tenant=spec.tenant, service_class=spec.service_class))
             continue
         if entry.done:
             plan.adopted[uid] = result_from_entry(entry)
@@ -172,9 +178,14 @@ def plan_recovery(state: JournalState, specs: Sequence[ServeSpec], *,
             plan.finalize.append((uid, OK, {"finish_reason": finish,
                                             "n_tokens": len(emitted)}))
             continue
+        # identity comes from the JOURNAL, not the spec: the journaled
+        # tenant/class is what admission actually accepted — recovery must
+        # not let a resubmitted spec launder a best-effort request into
+        # interactive (or reassign its tenant) across a crash
         plan.entries.append(RecoveredRequest(
             uid=uid, prompt=list(prompt), prefix=list(emitted),
-            priority=entry.priority, ttl_s=remaining, pin_ttl=True))
+            priority=entry.priority, ttl_s=remaining, pin_ttl=True,
+            tenant=entry.tenant, service_class=entry.service_class))
         if emitted:
             plan.recovered += 1
     return plan

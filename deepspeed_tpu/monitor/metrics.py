@@ -628,6 +628,48 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
                         roofline.uncosted_dispatches,
                         help_text="dispatches of buckets with no captured "
                                   "cost analysis (roofline blind spots)")
+    # multi-tenant QoS (ISSUE 19): per-tenant admission, token, shed and
+    # resident-KV families plus per-tenant SLO histograms — present only
+    # when the policy layer is armed (serving_qos.enabled), so a QoS-off
+    # scrape stays byte-identical to the pre-QoS exposition
+    qos = getattr(engine, "qos", None)
+    if qos is not None:
+        for (tenant, cls), count in sorted(qos.admitted_by_tenant.items()):
+            reg.set_counter(f"{reg.namespace}_serving_tenant_admitted_total",
+                            count, labels={"tenant": tenant, "class": cls},
+                            help_text="requests admitted, by tenant and "
+                                      "service class")
+        for tenant, count in sorted(qos.tokens_by_tenant.items()):
+            reg.set_counter(f"{reg.namespace}_serving_tenant_tokens_total",
+                            count, labels={"tenant": tenant},
+                            help_text="prompt tokens charged against the "
+                                      "tenant's rate quota at admission")
+        for (tenant, code), count in sorted(qos.shed_by_tenant.items()):
+            reg.set_counter(f"{reg.namespace}_serving_tenant_shed_total",
+                            count, labels={"tenant": tenant, "code": code},
+                            help_text="requests shed at the QoS door, by "
+                                      "tenant and structured reason code")
+        for tenant, hint in sorted(qos.last_retry_after_by_tenant.items()):
+            reg.set_gauge(
+                f"{reg.namespace}_serving_tenant_retry_after_seconds",
+                hint, labels={"tenant": tenant},
+                help_text="latest quota-derived retry hint per tenant "
+                          "(time until the token bucket refills)")
+        for tenant, blocks in sorted(engine.manager.tenant_block_usage().items()):
+            reg.set_gauge(f"{reg.namespace}_serving_tenant_kv_blocks",
+                          blocks, labels={"tenant": tenant},
+                          help_text="KV blocks resident per tenant (live "
+                                    "sequences only)")
+        tenant_hist_help = {
+            "ttft": "per-tenant time to first token",
+            "e2e": "per-tenant end-to-end request latency",
+        }
+        for (tenant, name), hist in sorted(engine.tracer.tenant_histograms()
+                                           .items()):
+            reg.set_histogram(
+                f"{reg.namespace}_serving_tenant_{name}_seconds", hist,
+                labels={"tenant": tenant},
+                help_text=tenant_hist_help[name])
 
 
 def populate_from_telemetry(reg: MetricsRegistry, collector) -> None:
@@ -747,6 +789,19 @@ def populate_from_router(reg: MetricsRegistry, router) -> None:
                       labels={"replica": str(replica.index)},
                       help_text="1 once the replica's restart budget "
                                 "exhausted and its work migrated away")
+    # per-tenant fleet counters (ISSUE 19): placement distribution and
+    # tenant-global quota sheds (the sheds the router refuses to re-route —
+    # families absent until a tenant-labeled workload arrives)
+    for tenant, count in sorted(router.routed_by_tenant.items()):
+        reg.set_counter(f"{ns}_router_tenant_routed_total", count,
+                        labels={"tenant": tenant},
+                        help_text="requests routed, by tenant")
+    for tenant, count in sorted(router.quota_sheds_by_tenant.items()):
+        reg.set_counter(f"{ns}_router_tenant_quota_sheds_total", count,
+                        labels={"tenant": tenant},
+                        help_text="quota_exceeded sheds surfaced to the "
+                                  "caller (tenant-global — never re-routed "
+                                  "to a sibling replica)")
 
 
 def populate_from_agent(reg: MetricsRegistry, agent,

@@ -707,6 +707,64 @@ class ServingFleetConfig(ConfigModel):
     namespace: str = "dstpu"
 
 
+class ServingQosConfig(ConfigModel):
+    """Multi-tenant QoS policy over the v2 serving plane
+    (inference/v2/qos.py — the *policy* layer on the existing admission /
+    preemption / prefix-cache *mechanisms*; no reference section, the
+    reference's ragged engine is single-tenant and delegates isolation to
+    external serving infra).
+
+    Every request carries a ``tenant`` id and a service class
+    (``interactive`` / ``batch`` / ``best_effort``).  With
+    ``enabled=false`` (the default) the layer is inert: requests get the
+    default tenant, dequeue order, prefix-cache keying and preemption
+    victims are byte-identical to the policy-free engine.
+
+    Front-door quotas (checked BEFORE any KV allocation, like every other
+    shed): ``tenant_tokens_per_s`` rate-limits each tenant's admitted
+    token volume through a token bucket of capacity
+    ``tenant_token_burst`` (0 disables; burst defaults to one second of
+    rate).  ``tenant_max_kv_blocks`` caps a tenant's RESIDENT KV blocks;
+    a tenant at its cap is shed rather than allowed to starve its
+    neighbors' pool.  Both produce a structured, retryable
+    ``quota_exceeded`` shed whose ``retry_after_s`` is the exact bucket
+    refill time (rate) or a pressure-scaled hint (KV), riding the
+    FleetRouter's existing backoff path.  ``tenants`` maps tenant id to
+    per-tenant overrides (``tokens_per_s`` / ``token_burst`` /
+    ``max_kv_blocks``).
+
+    Weighted-fair dequeue: the admission queue becomes per-class with
+    deficit-round-robin on TOKEN cost — each visit grants a class
+    ``drr_quantum_tokens * weight`` deficit, so interactive (weight 8 by
+    default) drains ~8x the token volume of best-effort per round while
+    best-effort still makes progress (starvation-free by construction).
+    Priority ordering within a class is preserved.  The DRR state is pure
+    arrival-sequence arithmetic — no clock reads — so dequeue order is
+    FakeClock-deterministic and rerun-identical.
+
+    ``preempt_over_quota`` steers KV-pressure preemption: victims are
+    preferred over-quota-tenant first, then lower class, then the PR-4
+    newest-prefill heuristic as the tie-break.
+
+    Isolation: the tenant id is folded into the chained block-hash key,
+    so cross-tenant prompts can NEVER share prefix blocks (closes the
+    cross-tenant cache-timing side-channel); the default tenant keeps the
+    legacy keying, so single-tenant sharing is unchanged.
+    """
+    enabled: bool = False
+    default_class: str = Field("interactive",
+                               choices=("interactive", "batch", "best_effort"))
+    interactive_weight: int = Field(8, ge=1)
+    batch_weight: int = Field(2, ge=1)
+    best_effort_weight: int = Field(1, ge=1)
+    drr_quantum_tokens: int = Field(64, ge=1)
+    tenant_tokens_per_s: float = Field(0.0, ge=0.0)  # 0 => no rate quota
+    tenant_token_burst: float = Field(0.0, ge=0.0)  # 0 => 1s of rate
+    tenant_max_kv_blocks: int = Field(0, ge=0)  # 0 => no KV quota
+    tenants: Dict[str, Any] = Field(dict)  # per-tenant quota overrides
+    preempt_over_quota: bool = True
+
+
 class KVObservabilityConfig(ConfigModel):
     """Block-level observability over the paged KV pool for the v2 ragged
     engine (inference/v2/kv_metrics.py — no reference section: the CUDA
@@ -938,6 +996,10 @@ class TrainingConfig(ConfigModel):
     # prefix affinity, journaled failover migration) — same dual-spelling
     # contract as above
     serving_fleet: ServingFleetConfig = Field(ServingFleetConfig)
+    # multi-tenant QoS (priority classes, per-tenant quotas, weighted-fair
+    # dequeue, tenant-keyed prefix isolation) — same dual-spelling contract
+    # as above
+    serving_qos: ServingQosConfig = Field(ServingQosConfig)
 
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
