@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke ops-stress-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke qos-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed lint-suppressions
+.PHONY: test test-slow fast_then_slow bench telemetry-smoke resilience-smoke serving-resilience-smoke serving-fastpath-smoke tracing-smoke ops-smoke ops-stress-smoke kv-obs-smoke prefix-cache-smoke serving-recovery-smoke elastic-smoke perf-smoke fleet-smoke qos-smoke spec-decode-smoke bench-diff drift-families lint lint-baseline lint-api-surface lint-mesh-manifest lint-changed lint-suppressions
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -165,6 +165,14 @@ fleet-smoke:
 # zero stalls, pool fully reclaimed, serving_tenant_* families strict-parse
 qos-smoke:
 	JAX_PLATFORMS=cpu $(PY) run_tests.py --qos-smoke
+
+# speculative decoding (ISSUE 20): distribution parity proved under 25%
+# injected KV-allocator faults and expiring deadlines — greedy spec-on tokens
+# byte-identical to spec-off, rejection-sampler marginal within a measured
+# total-variation band of the filtered target at T>0, serving_spec_* families
+# strict-parse and agree with the engine counters, spec-off exposition clean
+spec-decode-smoke:
+	JAX_PLATFORMS=cpu $(PY) run_tests.py --spec-decode-smoke
 
 # bench regression gate (ISSUE 16): bin/dstpu-benchdiff under the committed
 # benchtrack.json policy — the committed BENCH_r04->r05 pair must pass and an

@@ -12,7 +12,8 @@ from ..runtime.config import (KVObservabilityConfig, OpsServerConfig,
                               ServingFleetConfig,
                               ServingPerfConfig,
                               ServingPrefixCacheConfig, ServingQosConfig,
-                              ServingResilienceConfig, ServingTracingConfig)
+                              ServingResilienceConfig,
+                              ServingSpecDecodeConfig, ServingTracingConfig)
 from ..runtime.config_utils import ConfigModel, Field
 
 DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
@@ -52,6 +53,10 @@ class InferenceConfig(ConfigModel):
     # serving hot-path policy (device-resident batch buffers, async step
     # pipelining, adaptive decode fusion) — inference/v2/fastpath.py
     serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
+    # speculative decoding on the fused decode path: draft/verify with exact
+    # rejection sampling — inference/v2/spec_decode.py (section defined in
+    # runtime/config.py so train+serve configs share one spelling)
+    serving_spec_decode: ServingSpecDecodeConfig = Field(ServingSpecDecodeConfig)
     # request-lifecycle tracing + SLO latency histograms + flight recorder —
     # monitor/tracing.py wired through the v2 serving stack (same section
     # spelling as runtime/config.py so train+serve configs share it)

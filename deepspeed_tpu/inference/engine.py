@@ -169,25 +169,40 @@ class InferenceEngine:
         return np.concatenate([np.asarray(ids), gen], axis=1)
 
 
-def _sample(logits, rng, *, temperature, top_k, top_p):
-    """Temperature / top-k / top-p sampling on-device; greedy at T=0."""
+def _filter_logits(logits, *, temperature, top_k, top_p):
+    """Temperature scaling + top-k / top-p masking in fp32 — the ONE filtered
+    target distribution behind both :func:`_sample` and the spec-decode
+    rejection sampler (inference/v2/spec_decode.py): acceptance probabilities
+    and resampling must see byte-identical masking to what the plain sampled
+    path draws from, or spec mode would silently shift the distribution it is
+    proving it preserves.  ``temperature == 0`` must be handled by the caller
+    (greedy argmax, no filtering)."""
     logits = logits.astype(jnp.float32)
-    # temperature/top_k/top_p are Python scalars bound via functools.partial
-    # BEFORE jit at every call site (engine.generate, engine_v2 pick/burst), so
-    # these branches specialize the trace; only logits/rng are traced values
-    if temperature == 0.0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
     logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k and top_k > 0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
+    # top_k/top_p are Python scalars statically bound before jit at every call
+    # site (_sample binds via functools.partial; the spec verify program bakes
+    # its sample_cfg into the compile key), so these branches specialize traces
+    if top_k and top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p < 1.0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
+    if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -1e30, logits)
+    return logits
+
+
+def _sample(logits, rng, *, temperature, top_k, top_p):
+    """Temperature / top-k / top-p sampling on-device; greedy at T=0."""
+    # temperature/top_k/top_p are Python scalars bound via functools.partial
+    # BEFORE jit at every call site (engine.generate, engine_v2 pick/burst), so
+    # these branches specialize the trace; only logits/rng are traced values
+    if temperature == 0.0:  # dslint: disable=traced-control-flow  # statically bound via functools.partial at every jit site
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32), rng
+    logits = _filter_logits(logits, temperature=temperature, top_k=top_k, top_p=top_p)
     rng, sub = jax.random.split(rng)
     tok = jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32)
     return tok, rng

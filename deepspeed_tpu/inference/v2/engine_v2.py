@@ -37,13 +37,15 @@ from .admission import (DEADLINE_EXPIRED, FAILED, OK, PREEMPT_REQUEUED_EXHAUSTED
                         AdmissionQueue, RecoveredRequest, RequestResult,
                         ServingStalledError)
 from .blocked_allocator import KVAllocationError
-from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredTokens, DeviceBatchState,
-                       ServeCounters, materialize, round_up_pow2)
+from .fastpath import (FED_SENTINEL, PENDING_TOKEN, DeferredRuns, DeferredTokens,
+                       DeviceBatchState, ServeCounters, materialize, round_up_pow2)
 from .journal import RequestJournal, journal_bytes
 from .kv_metrics import KVObservability
 from .qos import QosPolicy
 from .ragged_manager import PrefixCache, RaggedStateManager
 from .scheduler import SplitFuseScheduler
+from .spec_decode import (AdaptiveKController, ModelDrafter, NgramDrafter,
+                          SpecDecodeStats, rejection_select)
 
 def candidate_sample(row, rng, *, temperature, top_k, top_p, axis):
     """Candidate-set sampling over a vocab-sharded logits row (reference
@@ -273,6 +275,23 @@ class InferenceEngineV2:
         self.batch_state = DeviceBatchState(
             self.counters, mesh=self.topology.mesh if self.tp > 1 else None,
             ledger=self.ledger)
+        # speculative decoding (ISSUE 20): drafter + adaptive-k controller +
+        # accounting behind the fused draft/verify path (decode_spec).
+        # Constructed only when the section is armed — with spec off (the
+        # default) every seam below (tokens, counters, journal bytes,
+        # Prometheus exposition) is byte-identical to the pre-spec stack.
+        self.spec_cfg = self.config.serving_spec_decode
+        self.spec_stats: Optional[SpecDecodeStats] = None
+        self._spec_controller: Optional[AdaptiveKController] = None
+        self._drafter = None
+        if self.spec_cfg.enabled:
+            self.spec_stats = SpecDecodeStats()
+            self._spec_controller = AdaptiveKController(self.spec_cfg)
+            if self.spec_cfg.drafter == "ngram":
+                self._drafter = NgramDrafter(self.spec_cfg.ngram_max,
+                                             self.spec_cfg.ngram_min)
+            # drafter == "model": speculation stays dormant (plain burst)
+            # until the caller provides weights via attach_draft_model()
         self._inflight: Optional[DeferredTokens] = None
         self._table_width = 0
         self._table_slack = 0
@@ -1114,6 +1133,9 @@ class InferenceEngineV2:
             self.manager.register_prefix_blocks(seq)
             self.counters.burst_tokens += n_real
             out[seq.uid] = produced
+        # fused work accounting (ISSUE 20): a k-step burst is k sequential
+        # steps' worth of decode work, without ever advancing scheduler.steps
+        self.scheduler.note_fused_work(k, sum(len(v) for v in out.values()))
         self.tracer.event("burst", step=self.scheduler.steps, k=k, seqs=len(live))
         self.tracer.on_burst_tokens({uid: len(toks_) for uid, toks_ in out.items()})
         if self.journal is not None:
@@ -1129,6 +1151,310 @@ class InferenceEngineV2:
         self._refresh_kv()
         self._emit_serving_gauges(tokens_run=sum(len(v) for v in out.values()))
         return out
+
+    # ----------------------------------------------------- speculative decode
+    def attach_draft_model(self, model_module, model_config, params, *,
+                           num_blocks: Optional[int] = None,
+                           block_size: Optional[int] = None) -> None:
+        """Arm ``drafter: "model"`` spec decode with a small draft model from
+        the model zoo (ISSUE 20): the drafter proposes greedily against its
+        own private paged pool (catch-up + k-token scan in one compiled
+        program per bucket) and its proposals feed the verify program without
+        ever visiting the host.  Under TP the draft model runs fully
+        replicated over the engine's mesh.  ``num_blocks``/``block_size``
+        size the private pool (defaults: mirror the target pool)."""
+        if not self.spec_cfg.enabled:
+            raise ValueError("serving_spec_decode.enabled is off — arm the "
+                             "section before attaching a draft model")
+        if self.spec_cfg.drafter != "model":
+            raise ValueError(f"serving_spec_decode.drafter is "
+                             f"'{self.spec_cfg.drafter}', not 'model'")
+        self._drafter = ModelDrafter(
+            model_module, model_config, params,
+            num_blocks=(num_blocks if num_blocks is not None
+                        else self.manager.allocator.num_blocks),
+            block_size=(block_size if block_size is not None
+                        else self.block_size),
+            max_blocks_per_seq=self.max_blocks_per_seq, dtype=self.dtype,
+            mesh=self.topology.mesh if self.tp > 1 else None,
+            ledger=self.ledger)
+
+    def _build_spec_verify_jit(self, n: int, k: int, sample_cfg=None):
+        """The fused verify program: ONE batched target forward over the
+        paged pool scoring (input token + k draft tokens) per sequence, then
+        the on-device rejection sampler — accept count and emitted run packed
+        into one [n, k+2] int32 array so the whole round rides one fetch."""
+        model, cfg, bs = self.model, self.model_config, self.block_size
+        width = jnp.full((n, ), k + 1, jnp.int32)
+        if self.tp > 1:
+            def verify(params, kv, tok0, draft, start0, tables, rng):
+                tokens = jnp.concatenate([tok0[:, None], draft], axis=1)
+                logits, kv = model.forward_paged(cfg, params, tokens, width,
+                                                 start0, tables, kv,
+                                                 block_size=bs,
+                                                 tp_axis=TENSOR_AXIS)
+                packed, rng = rejection_select(logits, draft, rng,
+                                               sample_cfg=sample_cfg)
+                return kv, packed, rng
+            verify = self._shard_mapped(
+                verify, (self._kv_specs, PartitionSpec(), PartitionSpec()))
+        else:
+            def verify(params, kv, tok0, draft, start0, tables, rng):
+                tokens = jnp.concatenate([tok0[:, None], draft], axis=1)
+                logits, kv = model.forward_paged(cfg, params, tokens, width,
+                                                 start0, tables, kv,
+                                                 block_size=bs)
+                packed, rng = rejection_select(logits, draft, rng,
+                                               sample_cfg=sample_cfg)
+                return kv, packed, rng
+        return jax.jit(verify, donate_argnums=(1, ))  # dslint: disable=donation-after-use  # call-site contract: decode_spec() reassigns self.kv from the result in the same statement
+
+    def _compiled_spec_verify(self, n: int, k: int, b: int, sample_cfg=None):
+        key = ("spec_verify", n, k, b, sample_cfg)
+        if key not in self._fwd_cache:
+            try:
+                self._aot_compile_spec_verify(n, k, b, sample_cfg,
+                                              prewarmed=False)
+            except Exception:
+                # same degrade as _compiled_fwd: lazy jit when AOT lowering
+                # fails — serving must not die on a backend quirk
+                self._fwd_cache[key] = self._build_spec_verify_jit(n, k,
+                                                                   sample_cfg)
+                self.ledger.record("spec_verify", key)
+        return self._fwd_cache[key]
+
+    def _aot_compile_spec_verify(self, n: int, k: int, b: int, sample_cfg=None,
+                                 *, prewarmed: bool = True) -> None:
+        """Prewarm one (n_seqs, draft_k, table_width) verify bucket: the AOT
+        bucket key includes the VERIFY WIDTH (k), so every rung of the
+        adaptive-k ladder is a compiled executable before the serve loop can
+        dispatch it — a mid-serve k drift re-uses a prewarmed program instead
+        of stalling p95 on a compile (the fwd-bucket contract extended to
+        spec mode).  Sharded avals under TP, same as _aot_compile_fwd."""
+        key = ("spec_verify", n, k, b, sample_cfg)
+        if key in self._fwd_cache:
+            return
+        if self.tp > 1:
+            rep = self.topology.replicated()
+            ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32, sharding=rep)
+            rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype,
+                                            sharding=rep)
+            abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                      sharding=x.sharding)
+        else:
+            ints = lambda shape: jax.ShapeDtypeStruct(shape, jnp.int32)
+            rng_aval = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+            abstract = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        t0 = time.perf_counter()  # dslint: disable=raw-clock-in-serving  # same contract as _aot_compile_fwd: measuring the synchronous XLA compile itself, never the engine clock
+        compiled = self._build_spec_verify_jit(n, k, sample_cfg).lower(
+            jax.tree_util.tree_map(abstract, self.params),
+            jax.tree_util.tree_map(abstract, self.kv),
+            ints((n, )), ints((n, k)), ints((n, )), ints((n, b)),
+            rng_aval).compile()
+        self._fwd_cache[key] = compiled
+        self.ledger.record("spec_verify", key, wall_s=time.perf_counter() - t0,  # dslint: disable=raw-clock-in-serving  # same stopwatch as t0 above — host compile duration, never the engine clock
+                           prewarmed=prewarmed)
+        if self.perf_cfg.capture_cost_analysis:
+            try:
+                cost = compiled.cost_analysis()
+                if isinstance(cost, list):
+                    cost = cost[0] if cost else {}
+                self.roofline.note_cost(key, float(cost.get("flops", 0.0)),
+                                        float(cost.get("bytes accessed", 0.0)))
+            except Exception:  # dslint: disable=silent-except  # cost analysis is best-effort, exactly as in _aot_compile_fwd
+                pass
+
+    def decode_spec(self, k: int, greedy: bool = True,
+                    eos_token_id: Optional[int] = None
+                    ) -> Optional[Dict[int, List[int]]]:
+        """One speculative draft/verify round over the pure-decode live set
+        (ISSUE 20): the drafter proposes ``k`` tokens per sequence, ONE
+        batched target forward scores all of them against the paged pool, and
+        the on-device rejection sampler emits the accepted prefix plus one
+        corrected/bonus token — 1..k+1 tokens per sequence for a single
+        target-weight HBM stream, distribution-exact vs plain decode (token-
+        identical under greedy).
+
+        Bookkeeping mirrors decode_burst: all-or-nothing block grab up front
+        (rolled back on an injected allocator fault), ONE host sync for the
+        packed accept runs, per-sequence seen-token advance by the ACCEPTED
+        length with trailing draft-overshoot blocks rolled back before they
+        can pollute shared prefix-cache state, WAL frames of verified tokens
+        only.  Returns None when not applicable (caller falls back to the
+        plain burst / stepwise paths)."""
+        drafter = self._drafter
+        if drafter is None:
+            return None
+        live, prefilling = self.scheduler.live_split(self.manager)
+        if not live or prefilling:
+            return None  # speculate only over a pure-decode live set
+        if len(live) > self.scheduler.max_seqs:
+            return None
+        if any(seq.deadline is not None for seq in live):
+            # deadline-armed sequences take the conservative path (the same
+            # disengage rule the async pipeline follows): a spec round emits
+            # a variable-length run per loop iteration, which would shift
+            # eviction timing relative to the plain engine — TTL partials
+            # must stay byte-identical to the spec-off stack
+            return None
+        if self._inflight is not None:
+            # the drafter reads token HISTORY: a deferred pick still in
+            # flight would leave PENDING_TOKEN placeholders in it
+            self._inflight.patch(self.manager)
+        max_pos = getattr(self.model_config, "max_seq_len", None)
+        total_new = 0
+        for seq in live:
+            upto = seq.seen_tokens + 1 + k
+            if self.manager.over_cap(upto):
+                return None
+            if max_pos is not None and upto > max_pos:
+                return None
+            total_new += self.manager.blocks_needed(seq, upto)
+        if not self.manager.can_allocate(total_new):
+            return None
+        grown: List = []
+        try:
+            for seq in live:
+                prior = len(seq.blocks)
+                self.manager.ensure_blocks(seq, seq.seen_tokens + 1 + k)
+                grown.append((seq, prior))
+        except KVAllocationError:
+            # injected/transient allocator fault mid-grab: full rollback so
+            # nothing is stranded, then decline — the burst/stepwise
+            # fallbacks retry at coarser/finer grain (census stays exact)
+            for seq, prior in grown:
+                self.manager.rollback_blocks(seq, prior)
+            return None
+
+        n = self._bucket(len(live))
+        b = self._table_width_for(max(len(s.blocks) for s in live))
+        tok0 = np.zeros((n, ), np.int32)
+        start0 = np.zeros((n, ), np.int32)
+        tables = np.full((n, b), self.manager.trash_block, np.int32)
+        for i, seq in enumerate(live):
+            tok0[i] = seq.tokens[seq.seen_tokens]
+            start0[i] = seq.seen_tokens
+            tables[i] = self.manager.block_table_row(seq, width=b)
+        draft = drafter.propose_batch(live, k, n, counters=self.counters)
+        if draft is None:
+            # the drafter's private pool couldn't cover the round: undo the
+            # target-pool grab and let the plain burst run instead
+            for seq, prior in grown:
+                self.manager.rollback_blocks(seq, prior)
+            return None
+        sample_cfg = None if greedy else (self.config.temperature,
+                                          self.config.top_k, self.config.top_p)
+        verify = self._compiled_spec_verify(n, k, b, sample_cfg=sample_cfg)
+        self.counters.dispatches += 1
+        if isinstance(draft, np.ndarray):
+            self.counters.uploads += 4
+            self.counters.upload_ints += int(tok0.size + start0.size
+                                             + tables.size + draft.size)
+            draft_dev = jnp.asarray(draft)
+        else:
+            # ModelDrafter proposals are already device-resident
+            self.counters.uploads += 3
+            self.counters.upload_ints += int(tok0.size + start0.size
+                                             + tables.size)
+            draft_dev = draft
+        self.kv, packed, self._rng = verify(self.params, self.kv,
+                                            jnp.asarray(tok0), draft_dev,
+                                            jnp.asarray(start0),
+                                            jnp.asarray(tables), self._rng)
+        handle = DeferredRuns(packed_dev=packed, uids=[s.uid for s in live],
+                              counters=self.counters)
+        raw = handle.runs()  # ONE sync absorbs the whole ragged round
+        bs = self.manager.block_size
+        out: Dict[int, List[int]] = {}
+        accepted_total = 0
+        max_run = 1
+        for seq in live:
+            run = raw[seq.uid]
+            if eos_token_id is not None:
+                for j, tok in enumerate(run):
+                    if tok == int(eos_token_id):
+                        run = run[:j + 1]
+                        break
+            accepted_total += max(0, len(run) - 1)
+            seq.tokens.extend(run)
+            seq.seen_tokens += len(run)
+            # the verify wrote KV for every draft position; positions past
+            # the accepted run are stale and their trailing blocks must not
+            # outlive the round — roll the table back to exactly the blocks
+            # covering the kept tokens (the census and prefix registration
+            # watermarks follow), before the allocator could hand a
+            # drafted-into block to another sequence as "free" later
+            keep = -(-len(seq.tokens) // bs)
+            if len(seq.blocks) > keep:
+                self.manager.rollback_blocks(seq, keep)
+            # a round's first position can complete the FINAL prompt block
+            # (same seam as the burst path)
+            self.manager.register_prefix_blocks(seq)
+            self.counters.burst_tokens += len(run)
+            max_run = max(max_run, len(run))
+            out[seq.uid] = run
+        self.counters.spec_rounds += 1
+        self.counters.spec_proposed += len(live) * k
+        self.counters.spec_accepted += accepted_total
+        self.spec_stats.note_round(len(live) * k, accepted_total,
+                                   [len(r) for r in out.values()])
+        self._spec_controller.note_round(len(live) * k, accepted_total)
+        # the deepest accepted run is the round's sequential-step equivalent
+        self.scheduler.note_fused_work(max_run,
+                                       sum(len(r) for r in out.values()))
+        self.tracer.event("spec_verify", step=self.scheduler.steps, k=k,
+                          seqs=len(live), accepted=accepted_total)
+        self.tracer.on_burst_tokens({uid: len(r) for uid, r in out.items()})
+        if self.journal is not None:
+            # VERIFIED tokens only ever reach the WAL: the accepted prefix +
+            # corrected token just materialized is the frame — an unverified
+            # draft token can never be journaled, so replay of a crash
+            # mid-verify regenerates byte-identical streams
+            self.journal.note_token_map(out)
+            self.journal.flush()
+        self._kv_steps += max_run
+        self._refresh_kv()
+        self._emit_serving_gauges(tokens_run=sum(len(r) for r in out.values()))
+        return out
+
+    def _fused_decode(self, window: int, *, greedy: bool,
+                      eos_token_id: Optional[int]
+                      ) -> Optional[Dict[int, List[int]]]:
+        """Dispatch one fused decode round: speculative draft/verify when the
+        section is armed and the adaptive-k controller is off its floor,
+        plain burst otherwise.  The draft length is snapped DOWN to the
+        largest ladder rung fitting both the controller's pick and the
+        remaining-budget window (emitting at most window tokens per
+        sequence), so every dispatched verify width is a prewarmable bucket
+        — never an off-ladder shape that would compile mid-serve."""
+        if self._drafter is not None and self._spec_controller is not None:
+            nk = self._spec_controller.next_k()
+            if nk > 1:
+                cap = min(nk, window - 1)
+                k_d = max((r for r in self._spec_controller.ladder if r <= cap),
+                          default=0)
+                if k_d >= 1:
+                    out = self.decode_spec(k_d, greedy=greedy,
+                                           eos_token_id=eos_token_id)
+                    if out is not None:
+                        return out
+                    if self.spec_stats is not None:
+                        self.spec_stats.fallback_rounds_total += 1
+        return self.decode_burst(window, greedy=greedy,
+                                 eos_token_id=eos_token_id)
+
+    def _spec_snapshot(self) -> Dict[str, Any]:
+        """``health()["spec_decode"]``: {"enabled": False} with the section
+        off (one shape for probes, same contract as qos), else controller
+        state (live k, acceptance EWMA, ladder) + lifetime counters + the
+        tokens-per-verify histogram."""
+        if self.spec_stats is None or self._spec_controller is None:
+            return {"enabled": False}
+        return {"enabled": True,
+                "drafter": (self.spec_cfg.drafter if self._drafter is not None
+                            else "none"),
+                **self._spec_controller.snapshot(),
+                **self.spec_stats.snapshot()}
 
     # ----------------------------------------------------------- convenience
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
@@ -1299,7 +1625,7 @@ class InferenceEngineV2:
             self._observe_prefix({uid: [int(t) for t in prompt]
                                   for uid, prompt in zip(uids, prompts)
                                   if uid not in results})
-            self._prewarm(max_new_tokens)
+            self._prewarm(max_new_tokens, greedy=greedy)
             if self.telemetry is not None:
                 # re-arm the serve-loop jax.profiler window for THIS
                 # generate() (ISSUE 16 satellite — one window per call)
@@ -1413,8 +1739,8 @@ class InferenceEngineV2:
                 k = self._fusion_window(uids, results, produced, max_new_tokens)
             if fusible and k >= fusion_min:
                 with self._phase_annotation("burst"):
-                    burst = self.decode_burst(k, greedy=greedy,
-                                              eos_token_id=eos_token_id)
+                    burst = self._fused_decode(k, greedy=greedy,
+                                               eos_token_id=eos_token_id)
                 if burst:
                     for uid, toks in burst.items():
                         if uid not in my or uid in results:
@@ -1633,12 +1959,15 @@ class InferenceEngineV2:
         self._stall_streak = 0  # the wedge was evicted with everything else
 
     # ------------------------------------------------- serving-loop internals
-    def _prewarm(self, max_new_tokens: int) -> None:
+    def _prewarm(self, max_new_tokens: int, greedy: bool = True) -> None:
         """Serve-time compile-cache prewarm: AOT-compile the forward buckets
         this call's queued + live requests are about to hit (bounded by
         ``serving_fastpath.prewarm_buckets``) so the first wave doesn't pay
-        mid-serve compile stalls.  Best-effort — any lowering failure falls
-        back to compile-on-first-step."""
+        mid-serve compile stalls.  With spec decode armed, ALSO prewarm the
+        verify bucket for every adaptive-k ladder rung — the AOT key includes
+        the verify width, so a k drift mid-serve lands on a compiled
+        executable (zero warm recompiles in spec mode).  Best-effort — any
+        lowering failure falls back to compile-on-first-step."""
         fp = self.fastpath
         if not fp.enabled or fp.prewarm_buckets <= 0:
             return
@@ -1670,6 +1999,31 @@ class InferenceEngineV2:
                              f"failed ({e}); falling back to on-demand compile")
                 return
             warmed += 1
+        if self._drafter is None or self._spec_controller is None:
+            return
+        sample_cfg = None if greedy else (self.config.temperature,
+                                          self.config.top_k, self.config.top_p)
+        ladder = self._spec_controller.ladder
+        # deepest verify reach: prompt + per-round input token + run budget +
+        # the largest rung of draft overshoot that the rollback then trims
+        w_verify = self._stepped_width(
+            -(-(max_prompt + 1 + max_new_tokens + max(ladder)) // bs))
+        warmed_spec = 0
+        for rung in ladder:
+            for w in sorted({w_decode, w_verify}):
+                if warmed_spec >= fp.prewarm_buckets:
+                    return
+                if ("spec_verify", n_b, rung, w, sample_cfg) in self._fwd_cache:
+                    continue
+                try:
+                    self._aot_compile_spec_verify(n_b, rung, w, sample_cfg)
+                except Exception as e:
+                    from ...utils.logging import warning_once
+                    warning_once(f"spec decode: prewarm of verify bucket "
+                                 f"{(n_b, rung, w)} failed ({e}); falling "
+                                 f"back to on-demand compile")
+                    return
+                warmed_spec += 1
 
     def _finish_ok(self, uid: int, results: Dict[int, RequestResult],
                    finish_reason: str) -> None:
@@ -1978,4 +2332,8 @@ class InferenceEngineV2:
             # key on one shape
             "qos": (self.qos.snapshot() if self.qos is not None
                     else {"enabled": False}),
+            # speculative decoding (ISSUE 20): adaptive-k controller state,
+            # lifetime proposal/acceptance counters, tokens-per-verify
+            # histogram — {"enabled": False} when the section is off
+            "spec_decode": self._spec_snapshot(),
         }

@@ -66,10 +66,17 @@ class ServeCounters:
     ``loop_iterations`` serve-loop iterations observed
     ``step_tokens`` / ``burst_tokens``  tokens emitted via stepwise vs fused
     ``flushes``      pipeline flushes forced by wave boundaries
+    ``spec_rounds``  speculative draft/verify rounds dispatched (ISSUE 20)
+    ``spec_proposed`` / ``spec_accepted``  draft tokens proposed vs accepted
+    by the target's rejection sampler — their ratio is the acceptance rate
+    behind the adaptive-k controller and the ``serving_spec_*`` metric
+    families.  All three stay zero with spec decode off (the default), so
+    the pre-spec counter fields keep their exact pre-spec values.
     """
 
     FIELDS = ("host_syncs", "dispatches", "uploads", "upload_ints", "compiles",
-              "loop_iterations", "step_tokens", "burst_tokens", "flushes")
+              "loop_iterations", "step_tokens", "burst_tokens", "flushes",
+              "spec_rounds", "spec_proposed", "spec_accepted")
 
     def __init__(self):
         for f in self.FIELDS:
@@ -168,6 +175,47 @@ class DeferredTokens:
         """Forget a uid's pending emit (its overshoot token was truncated)."""
         self.emits = [e for e in self.emits if e[0] != uid]
         self.row_of.pop(uid, None)
+
+
+@dataclasses.dataclass
+class DeferredRuns:
+    """Handle to one speculative verify round's packed accept runs still on
+    device (ISSUE 20) — the variable-length sibling of :class:`DeferredTokens`.
+
+    ``packed_dev`` holds ``[n, k+2]`` int32 rows ``[count | e_0 .. e_k]``
+    from the fused verify program's rejection sampler: row i emits its first
+    ``count`` tokens (1 <= count <= k+1 — the accepted draft prefix plus one
+    corrected/bonus token).  The count and the run ride the SAME array, so
+    absorbing a whole verify round costs the one wave-boundary
+    :func:`materialize` the burst path already pays — per-sequence
+    acceptance-length variance never adds a second sync.
+
+    ``uids`` maps batch row -> sequence uid for the live rows; padded rows
+    beyond ``len(uids)`` carry garbage runs and are never read.
+    """
+    packed_dev: object
+    uids: List[int]
+    counters: Optional[ServeCounters] = None
+    _cached: Optional[np.ndarray] = None
+
+    def wait(self) -> np.ndarray:
+        """Materialize the packed accept runs (idempotent)."""
+        if self._cached is None:
+            self._cached = materialize(self.packed_dev, self.counters)
+        return self._cached
+
+    def runs(self) -> Dict[int, List[int]]:
+        """``{uid: emitted tokens}`` — each row truncated to its accept
+        count.  Emitted runs are VERIFIED output (accepted prefix + the
+        resampled token); unverified draft tails never leave this handle, so
+        downstream seams (journal frames, tracer marks) can never observe a
+        token the target model did not endorse."""
+        packed = self.wait()
+        out: Dict[int, List[int]] = {}
+        for i, uid in enumerate(self.uids):
+            count = int(packed[i, 0])
+            out[uid] = [int(t) for t in packed[i, 1:1 + count]]
+        return out
 
 
 @dataclasses.dataclass

@@ -57,10 +57,30 @@ class SplitFuseScheduler:
         self.qos = None
         self.steps = 0
         self.preempted_total = 0
+        # fused-decode work accounting (ISSUE 20): `steps` NEVER advances
+        # inside a fused burst or a speculative verify (that contract keeps
+        # step-keyed seams — watchdog signatures, trace step stamps —
+        # identical across decode paths), so fairness/preemption math that
+        # wants decode work in step units reads these instead: a k-step burst
+        # notes k fused steps, and a speculative verify notes the deepest
+        # per-sequence accepted run (its sequential-step equivalent) plus
+        # every emitted token
+        self.fused_steps = 0
+        self.fused_tokens = 0
         self.last_gauges: Dict[str, float] = {}
         self._requeued: set = set()  # victims preempted THIS step (skip their prefill)
         self._reserve_faulted = False  # last _reserve failed on an injected/transient
         # allocator fault (pool may have room) rather than genuine exhaustion
+
+    def note_fused_work(self, steps: int, tokens: int) -> None:
+        """Record one fused decode round's work in step units (ISSUE 20):
+        ``steps`` is the round's sequential-step equivalent (burst length k,
+        or a speculative round's deepest accepted run) and ``tokens`` the
+        tokens it emitted across the batch — so a verify that emits between 1
+        and k+1 tokens per sequence is charged as k-token decode work for
+        fairness accounting without ever advancing :attr:`steps` mid-burst."""
+        self.fused_steps += int(steps)
+        self.fused_tokens += int(tokens)
 
     def live_split(self, manager: RaggedStateManager
                    ) -> "tuple[List[SequenceDescriptor], List[SequenceDescriptor]]":

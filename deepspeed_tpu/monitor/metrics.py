@@ -670,6 +670,47 @@ def populate_from_engine(reg: MetricsRegistry, engine) -> None:
                 f"{reg.namespace}_serving_tenant_{name}_seconds", hist,
                 labels={"tenant": tenant},
                 help_text=tenant_hist_help[name])
+    # speculative decoding (ISSUE 20): proposal/acceptance counters, live
+    # acceptance gauge, and the tokens-per-verify histogram — present only
+    # when the section is armed (serving_spec_decode.enabled), so a spec-off
+    # scrape stays byte-identical to the pre-spec exposition
+    spec = getattr(engine, "spec_stats", None)
+    if spec is not None:
+        reg.set_counter(f"{reg.namespace}_serving_spec_proposed_total",
+                        spec.proposed_total,
+                        help_text="draft tokens proposed to the verifier")
+        reg.set_counter(f"{reg.namespace}_serving_spec_accepted_total",
+                        spec.accepted_total,
+                        help_text="draft tokens accepted by rejection "
+                                  "sampling (bonus/corrected tokens excluded)")
+        reg.set_counter(f"{reg.namespace}_serving_spec_rounds_total",
+                        spec.rounds_total,
+                        help_text="draft/verify rounds dispatched")
+        reg.set_counter(f"{reg.namespace}_serving_spec_fallback_rounds_total",
+                        spec.fallback_rounds_total,
+                        help_text="rounds that declined to speculate and fell "
+                                  "back to the plain fused burst")
+        reg.set_gauge(f"{reg.namespace}_serving_spec_acceptance",
+                      spec.acceptance_rate(),
+                      help_text="lifetime draft-token acceptance rate [0, 1] "
+                                "— the adaptive-k controller steers its EWMA "
+                                "twin of this")
+        # the per-round run lengths live as exact small-int counts on the
+        # engine; rendered as a mergeable streaming histogram like every
+        # other latency/size family (direct bucket fill — same idiom as
+        # MetricsRegistry._histogram_from_snapshot)
+        hist = StreamingHistogram()
+        for length, n in sorted(spec.tokens_per_verify.items()):
+            idx = hist._index(float(length))
+            hist.counts[idx] = hist.counts.get(idx, 0) + int(n)
+            hist.count += int(n)
+            hist.total += float(length) * int(n)
+            if hist.max_seen is None or float(length) > hist.max_seen:
+                hist.max_seen = float(length)
+        reg.set_histogram(f"{reg.namespace}_serving_spec_tokens_per_verify",
+                          hist,
+                          help_text="tokens emitted per verify round per "
+                                    "sequence (accepted prefix + 1)")
 
 
 def populate_from_telemetry(reg: MetricsRegistry, collector) -> None:

@@ -509,6 +509,54 @@ class ServingFastpathConfig(ConfigModel):
     prewarm_buckets: int = Field(4, ge=0)
 
 
+class ServingSpecDecodeConfig(ConfigModel):
+    """Speculative decoding on the v2 engine's fused decode path (ISSUE 20 —
+    inference/v2/spec_decode.py; the XLA translation of Leviathan et al.'s
+    draft/verify with exact rejection sampling, applied per-sequence inside
+    the Orca-style ragged batch).
+
+    ``enabled`` arms the spec path: on every pure-decode fused window a
+    drafter proposes ``k`` tokens per sequence, the target model verifies all
+    of them in ONE batched forward over the paged KV pool, and on-device
+    rejection sampling accepts the longest valid prefix plus one resampled
+    token — between 1 and k+1 tokens per sequence per round, with the output
+    distribution provably the target model's (token-identical to spec-off
+    under greedy decode; distribution-identical under temperature/top-k/
+    top-p sampling).  Off (the default) the engine is byte-identical to the
+    pre-spec stack.
+
+    ``drafter`` picks the proposal source: ``"ngram"`` is the zero-weight
+    prompt-lookup drafter (longest-suffix n-gram match over the sequence's
+    own token history — no second model, proposals cost pure host python);
+    ``"model"`` uses a small draft model from the model zoo attached via
+    ``InferenceEngineV2.attach_draft_model(...)`` (greedy-drafted against
+    its own paged pool, replicated under the engine's mesh).
+
+    ``k`` caps the draft length; the ADAPTIVE controller moves the live k
+    through a small static ladder (1, 3, 7, 15, ... capped at ``k`` —
+    verify widths k+1 stay powers of two) on an EWMA of the acceptance rate
+    (``ewma_alpha``; raise above ``raise_threshold``, lower below
+    ``lower_threshold``), so every verify program is one of a handful of
+    prewarmable bucket shapes and a drifting acceptance rate can never
+    recompile mid-serve.  At the k=1 floor the engine falls back to the
+    plain fused burst (zero spec overhead, zero recompiles) and re-probes
+    spec every ``probe_every`` fused rounds.  ``adaptive_k=False`` pins k.
+
+    ``ngram_max``/``ngram_min`` bound the suffix-match length the n-gram
+    drafter tries (longest first).
+    """
+    enabled: bool = False
+    drafter: str = Field("ngram", choices=("ngram", "model"))
+    k: int = Field(4, ge=1)
+    adaptive_k: bool = True
+    ewma_alpha: float = Field(0.3, gt=0.0, le=1.0)
+    raise_threshold: float = Field(0.7, ge=0.0, le=1.0)
+    lower_threshold: float = Field(0.3, ge=0.0, le=1.0)
+    probe_every: int = Field(16, ge=1)
+    ngram_max: int = Field(3, ge=1)
+    ngram_min: int = Field(1, ge=1)
+
+
 class ServingTracingConfig(ConfigModel):
     """Request-lifecycle tracing + SLO latency histograms for the v2 ragged
     engine (monitor/tracing.py wired through inference/v2 — no reference
@@ -974,6 +1022,9 @@ class TrainingConfig(ConfigModel):
     # serving hot-path knobs (device-resident batch state, step pipelining,
     # adaptive decode fusion) — same dual-spelling contract as above
     serving_fastpath: ServingFastpathConfig = Field(ServingFastpathConfig)
+    # speculative decoding on the fused decode path (draft/verify with exact
+    # rejection sampling) — same dual-spelling contract as above
+    serving_spec_decode: ServingSpecDecodeConfig = Field(ServingSpecDecodeConfig)
     # request-lifecycle tracing, SLO latency histograms, flight recorder —
     # same dual-spelling contract as above
     serving_tracing: ServingTracingConfig = Field(ServingTracingConfig)

@@ -155,6 +155,7 @@ class HostSyncInHotPath(Rule):
                    "monitor/exposition.py, monitor/ops_server.py) AND the "
                    "KV-pool observability layer (inference/v2/kv_metrics.py) "
                    "AND the serving perf observatory (monitor/perf.py) AND "
+                   "the spec-decode layer (inference/v2/spec_decode.py) AND "
                    "the bench regression tooling (tools/benchtrack/) "
                    "any explicit device fetch (np.asarray/np.array/device_get/"
                    "block_until_ready/.item) anywhere in the file — liveness "
@@ -209,6 +210,12 @@ class HostSyncInHotPath(Rule):
     # fetch in the front-end would stall EVERY request's admission, so the
     # full explicit-fetch set (plus .item()) applies module-wide
     ROUTER_PATH_FRAGMENT = "inference/v2/router.py"
+    # speculative decoding (ISSUE 20) holds it too: drafters run on host ints
+    # the engine already owns (n-gram) or entirely on device (draft model),
+    # and accept/reject accumulation stays on device until the engine's
+    # wave-boundary materialize — a fetch here would charge every verify
+    # round a hidden stall, so the whole file is scanned
+    SPEC_PATH_FRAGMENT = "inference/v2/spec_decode.py"
 
     def _is_hot(self, fn: ast.AST) -> bool:
         if fn.name in self.HOT_NAMES:
@@ -269,6 +276,15 @@ class HostSyncInHotPath(Rule):
                 "journal-transplant failover read host dicts and journal "
                 "files only, or every request's admission stalls on a device "
                 "round-trip")
+            return
+        if relpath.endswith(self.SPEC_PATH_FRAGMENT):
+            yield from self._check_zero_sync_file(
+                module, jit_roots,
+                " in inference/v2/spec_decode.py — drafters and the rejection "
+                "sampler are contractually zero-device-sync: accept/reject "
+                "accumulation stays on device until the engine's "
+                "wave-boundary fastpath.materialize(), or every verify round "
+                "charges an extra host stall")
             return
         in_v2 = self.V2_PATH_FRAGMENT in relpath
         seen: Set[int] = set()  # a nested def is also walked via its parent
